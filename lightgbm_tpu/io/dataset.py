@@ -1,0 +1,267 @@
+"""Binned dataset + metadata for lightgbm_tpu.
+
+TPU-native analogue of the reference's ``Dataset``/``Metadata``
+(reference: include/LightGBM/dataset.h:426,46; src/io/dataset.cpp,
+src/io/metadata.cpp). Where the reference keeps per-feature ``Bin`` columns
+(dense/sparse, 4/8/16-bit, src/io/dense_bin.hpp) optimized for CPU cache and
+histogram prefetch, the TPU build keeps ONE dense row-major uint8/uint16 bin
+matrix padded for HBM tiling — the analogue of the CUDA backend's row-wise
+``CUDARowData`` (reference: include/LightGBM/cuda/cuda_row_data.hpp:31-89) —
+because XLA histogramming wants a single contiguous [rows, features] tensor.
+
+Construction pipeline (reference: DatasetLoader::ConstructFromSampleData,
+src/io/dataset_loader.cpp:593):
+  sample rows -> BinMapper.find_bin per feature -> value_to_bin over the full
+  column -> drop trivial features -> pack.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..config import Config
+from ..utils import log
+from .binning import BinMapper, BinType, MissingType
+
+
+class Metadata:
+    """Labels / weights / query boundaries / init score
+    (reference: include/LightGBM/dataset.h:46, src/io/metadata.cpp:26)."""
+
+    def __init__(self, num_data: int):
+        self.num_data = num_data
+        self.label = np.zeros(num_data, dtype=np.float32)
+        self.weights: Optional[np.ndarray] = None
+        self.query_boundaries: Optional[np.ndarray] = None
+        self.init_score: Optional[np.ndarray] = None
+
+    def set_label(self, label: Sequence[float]) -> None:
+        label = np.asarray(label, dtype=np.float32).reshape(-1)
+        if len(label) != self.num_data:
+            log.fatal("Length of label (%d) != num_data (%d)"
+                      % (len(label), self.num_data))
+        self.label = label
+
+    def set_weights(self, weights: Optional[Sequence[float]]) -> None:
+        if weights is None:
+            self.weights = None
+            return
+        weights = np.asarray(weights, dtype=np.float32).reshape(-1)
+        if len(weights) != self.num_data:
+            log.fatal("Length of weights (%d) != num_data (%d)"
+                      % (len(weights), self.num_data))
+        self.weights = weights
+
+    def set_group(self, group: Optional[Sequence[int]]) -> None:
+        """Group sizes -> query boundaries
+        (reference: Metadata::SetQuery, src/io/metadata.cpp:456)."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        group = np.asarray(group, dtype=np.int64).reshape(-1)
+        if group.sum() != self.num_data:
+            log.fatal("Sum of group sizes (%d) != num_data (%d)"
+                      % (int(group.sum()), self.num_data))
+        self.query_boundaries = np.concatenate(
+            [[0], np.cumsum(group)]).astype(np.int32)
+
+    def set_init_score(self, init_score: Optional[Sequence[float]]) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        init_score = np.asarray(init_score, dtype=np.float64).reshape(-1)
+        if len(init_score) % max(self.num_data, 1) != 0:
+            # len == num_data or num_class * num_data
+            # (reference: Metadata::SetInitScore, src/io/metadata.cpp)
+            log.fatal("Length of init_score (%d) must be a multiple of "
+                      "num_data (%d)" % (len(init_score), self.num_data))
+        self.init_score = init_score
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+
+class BinnedDataset:
+    """Quantized training data (reference: include/LightGBM/dataset.h:426).
+
+    Attributes
+    ----------
+    bins : np.ndarray [num_data, num_used_features] uint8/uint16
+        Row-major bin matrix; the HBM-resident training payload.
+    bin_mappers : list[BinMapper]  (one per *used* feature)
+    used_feature_map : original column index per used feature
+    num_bin_per_feature / max_num_bin : histogram sizing
+    """
+
+    def __init__(self) -> None:
+        self.bins: np.ndarray = np.zeros((0, 0), dtype=np.uint8)
+        self.bin_mappers: List[BinMapper] = []
+        self.used_feature_map: List[int] = []
+        self.num_total_features: int = 0
+        self.feature_names: List[str] = []
+        self.metadata: Metadata = Metadata(0)
+        self.max_num_bin: int = 0
+        self.num_bin_per_feature: np.ndarray = np.zeros(0, dtype=np.int32)
+        self.monotone_constraints: Optional[np.ndarray] = None
+        self.feature_penalty: Optional[np.ndarray] = None
+        self.raw_data: Optional[np.ndarray] = None  # kept for linear trees
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_matrix(cls, data: np.ndarray, config: Config,
+                    label: Optional[Sequence[float]] = None,
+                    weights: Optional[Sequence[float]] = None,
+                    group: Optional[Sequence[int]] = None,
+                    init_score: Optional[Sequence[float]] = None,
+                    feature_names: Optional[List[str]] = None,
+                    categorical_feature: Optional[Sequence[Union[int, str]]] = None,
+                    reference: Optional["BinnedDataset"] = None,
+                    keep_raw_data: bool = False) -> "BinnedDataset":
+        """Build from a dense float matrix (reference:
+        DatasetLoader::ConstructFromSampleData, src/io/dataset_loader.cpp:593,
+        for the sample pass; Dataset::PushRow + FinishLoad for the full pass)."""
+        data = np.asarray(data)
+        if data.dtype not in (np.float32, np.float64):
+            data = data.astype(np.float64)
+        if data.ndim != 2:
+            log.fatal("Training data must be 2-dimensional")
+        n, num_total_features = data.shape
+        self = cls()
+        self.num_total_features = num_total_features
+        self.feature_names = list(feature_names) if feature_names else [
+            f"Column_{i}" for i in range(num_total_features)]
+
+        if categorical_feature is None and config.categorical_feature:
+            categorical_feature = config.categorical_feature
+        cat_set = _resolve_categorical(categorical_feature, self.feature_names)
+
+        if reference is not None:
+            # validation set aligned with the training set's bin mappers
+            # (reference: DatasetLoader::LoadFromFileAlignWithOtherDataset,
+            # src/io/dataset_loader.cpp:299)
+            self.bin_mappers = reference.bin_mappers
+            self.used_feature_map = reference.used_feature_map
+            self.num_bin_per_feature = reference.num_bin_per_feature
+            self.max_num_bin = reference.max_num_bin
+            self.monotone_constraints = reference.monotone_constraints
+            self.feature_penalty = reference.feature_penalty
+        else:
+            # --- sampling pass (bin_construct_sample_cnt, config.h:641) ---
+            sample_cnt = min(config.bin_construct_sample_cnt, n)
+            rng = np.random.RandomState(config.data_random_seed)
+            if sample_cnt < n:
+                sample_idx = np.sort(rng.choice(n, sample_cnt, replace=False))
+                sample = data[sample_idx]
+            else:
+                sample = data
+            max_bin_by_feature = config.max_bin_by_feature
+            mappers: List[BinMapper] = []
+            for f in range(num_total_features):
+                bm = BinMapper()
+                max_bin_f = (max_bin_by_feature[f]
+                             if f < len(max_bin_by_feature) else config.max_bin)
+                bm.find_bin(
+                    sample[:, f], total_sample_cnt=len(sample),
+                    max_bin=max_bin_f,
+                    min_data_in_bin=config.min_data_in_bin,
+                    min_split_data=config.min_data_in_leaf,
+                    pre_filter=config.feature_pre_filter,
+                    bin_type=(BinType.CATEGORICAL if f in cat_set
+                              else BinType.NUMERICAL),
+                    use_missing=config.use_missing,
+                    zero_as_missing=config.zero_as_missing)
+                mappers.append(bm)
+            self.bin_mappers = [m for m in mappers if not m.is_trivial]
+            self.used_feature_map = [i for i, m in enumerate(mappers)
+                                     if not m.is_trivial]
+            if not self.bin_mappers:
+                log.warning("There are no meaningful features which satisfy "
+                            "the provided configuration. Decreasing "
+                            "Dataset parameters min_data_in_bin or min_data_in_leaf "
+                            "and re-constructing Dataset might resolve this warning.")
+            self.num_bin_per_feature = np.asarray(
+                [m.num_bin for m in self.bin_mappers], dtype=np.int32)
+            self.max_num_bin = int(self.num_bin_per_feature.max()) if len(
+                self.num_bin_per_feature) else 1
+            self._set_constraints(config)
+
+        # --- full binning pass ---
+        dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
+        bins = np.empty((n, len(self.bin_mappers)), dtype=dtype)
+        for j, (f, bm) in enumerate(zip(self.used_feature_map,
+                                        self.bin_mappers)):
+            bins[:, j] = bm.value_to_bin(data[:, f]).astype(dtype)
+        self.bins = bins
+        if keep_raw_data:
+            self.raw_data = data
+
+        self.metadata = Metadata(n)
+        if label is not None:
+            self.metadata.set_label(label)
+        self.metadata.set_weights(weights)
+        self.metadata.set_group(group)
+        self.metadata.set_init_score(init_score)
+        return self
+
+    # ------------------------------------------------------------------
+    def _set_constraints(self, config: Config) -> None:
+        if config.monotone_constraints:
+            mc = np.zeros(len(self.bin_mappers), dtype=np.int8)
+            for j, f in enumerate(self.used_feature_map):
+                if f < len(config.monotone_constraints):
+                    mc[j] = config.monotone_constraints[f]
+            self.monotone_constraints = mc
+        if config.feature_contri:
+            fp = np.ones(len(self.bin_mappers), dtype=np.float64)
+            for j, f in enumerate(self.used_feature_map):
+                if f < len(config.feature_contri):
+                    fp[j] = config.feature_contri[f]
+            self.feature_penalty = fp
+
+    # ------------------------------------------------------------------
+    @property
+    def num_data(self) -> int:
+        return self.bins.shape[0]
+
+    @property
+    def num_features(self) -> int:
+        return self.bins.shape[1]
+
+    def real_threshold(self, feature: int, bin_idx: int) -> float:
+        """Bin index -> real-valued split threshold for model storage
+        (reference: Tree::Split records RealThreshold via BinToValue)."""
+        return self.bin_mappers[feature].bin_to_value(bin_idx)
+
+    def real_feature_index(self, inner_feature: int) -> int:
+        return self.used_feature_map[inner_feature]
+
+    def inner_feature_index(self, real_feature: int) -> int:
+        try:
+            return self.used_feature_map.index(real_feature)
+        except ValueError:
+            return -1
+
+    def feature_infos(self) -> List[str]:
+        infos = ["none"] * self.num_total_features
+        for f, bm in zip(self.used_feature_map, self.bin_mappers):
+            infos[f] = bm.feature_info()
+        return infos
+
+
+def _resolve_categorical(categorical_feature, feature_names) -> set:
+    cats: set = set()
+    if categorical_feature is None or categorical_feature == "auto":
+        return cats
+    if isinstance(categorical_feature, str):
+        categorical_feature = [c for c in categorical_feature.split(",") if c]
+    for c in categorical_feature:
+        if isinstance(c, str) and not c.lstrip("-").isdigit():
+            if c in feature_names:
+                cats.add(feature_names.index(c))
+            else:
+                log.warning("Unknown categorical feature name: %s", c)
+        else:
+            cats.add(int(c))
+    return cats

@@ -1,0 +1,76 @@
+"""Leveled logging for lightgbm_tpu.
+
+TPU-native analogue of the reference's ``Log`` utility
+(reference: include/LightGBM/utils/log.h:178): leveled Debug/Info/Warning/Fatal
+where Fatal raises instead of aborting, and the sink is redirectable (the
+reference exposes LGBM_RegisterLogCallback, src/c_api.cpp:904; here the sink is
+just a Python callable).
+"""
+from __future__ import annotations
+
+import sys
+from enum import IntEnum
+from typing import Callable, Optional
+
+
+class LogLevel(IntEnum):
+    FATAL = -1
+    WARNING = 0
+    INFO = 1
+    DEBUG = 2
+
+
+class LightGBMError(Exception):
+    """Raised on fatal errors (reference: Log::Fatal throws std::runtime_error)."""
+
+
+_level: LogLevel = LogLevel.INFO
+_sink: Optional[Callable[[str], None]] = None
+
+
+def set_verbosity(verbosity: int) -> None:
+    """Map the reference's ``verbosity`` config to a log level.
+
+    <0: fatal only, 0: warning, 1: info, >1: debug
+    (reference: include/LightGBM/config.h:567 + c_api.cpp verbosity handling).
+    """
+    global _level
+    if verbosity < 0:
+        _level = LogLevel.FATAL
+    elif verbosity == 0:
+        _level = LogLevel.WARNING
+    elif verbosity == 1:
+        _level = LogLevel.INFO
+    else:
+        _level = LogLevel.DEBUG
+
+
+def register_log_callback(fn: Optional[Callable[[str], None]]) -> None:
+    global _sink
+    _sink = fn
+
+
+def _emit(msg: str) -> None:
+    if _sink is not None:
+        _sink(msg + "\n")
+    else:
+        print(msg, file=sys.stderr, flush=True)
+
+
+def debug(msg: str, *args) -> None:
+    if _level >= LogLevel.DEBUG:
+        _emit("[LightGBM-TPU] [Debug] " + (msg % args if args else msg))
+
+
+def info(msg: str, *args) -> None:
+    if _level >= LogLevel.INFO:
+        _emit("[LightGBM-TPU] [Info] " + (msg % args if args else msg))
+
+
+def warning(msg: str, *args) -> None:
+    if _level >= LogLevel.WARNING:
+        _emit("[LightGBM-TPU] [Warning] " + (msg % args if args else msg))
+
+
+def fatal(msg: str, *args) -> None:
+    raise LightGBMError(msg % args if args else msg)

@@ -1,0 +1,187 @@
+"""BinMapper / BinnedDataset tests.
+
+Covers the semantics of the reference's quantizer (src/io/bin.cpp:78-491):
+monotone boundaries, zero-as-one-bin, missing types, categorical coverage,
+trivial-feature filtering.
+"""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.binning import BinMapper, BinType, MissingType
+from lightgbm_tpu.io.dataset import BinnedDataset
+
+
+def _cfg(**kw):
+    kw.setdefault("verbose", -1)
+    return Config.from_params(kw)
+
+
+class TestNumericalBinning:
+    def test_basic_properties(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(10000)
+        bm = BinMapper()
+        bm.find_bin(x, len(x), max_bin=255)
+        assert 2 <= bm.num_bin <= 255
+        assert bm.missing_type == MissingType.NONE
+        assert not bm.is_trivial
+        # boundaries strictly increasing, last is +inf
+        assert np.all(np.diff(bm.bin_upper_bound) > 0)
+        assert bm.bin_upper_bound[-1] == np.inf
+
+    def test_binning_is_monotone(self):
+        rng = np.random.RandomState(1)
+        x = np.sort(rng.randn(5000))
+        bm = BinMapper()
+        bm.find_bin(x, len(x), max_bin=63)
+        bins = bm.value_to_bin(x)
+        assert np.all(np.diff(bins) >= 0)
+        assert bins.max() <= bm.num_bin - 1
+
+    def test_values_respect_boundaries(self):
+        rng = np.random.RandomState(2)
+        x = rng.exponential(size=3000)
+        bm = BinMapper()
+        bm.find_bin(x, len(x), max_bin=31)
+        bins = bm.value_to_bin(x)
+        for b in range(bm.num_bin):
+            in_bin = x[bins == b]
+            if len(in_bin) == 0:
+                continue
+            assert np.all(in_bin <= bm.bin_upper_bound[b])
+            if b > 0:
+                assert np.all(in_bin > bm.bin_upper_bound[b - 1])
+
+    def test_zero_has_own_bin(self):
+        # FindBinWithZeroAsOneBin: zero never shares a bin with nonzeros
+        rng = np.random.RandomState(3)
+        x = rng.randn(4000)
+        x[rng.rand(4000) < 0.5] = 0.0
+        bm = BinMapper()
+        bm.find_bin(x, len(x), max_bin=255)
+        zero_bin = int(bm.value_to_bin(0.0))
+        nonzero_bins = bm.value_to_bin(x[np.abs(x) > 1e-30])
+        assert zero_bin not in set(nonzero_bins.tolist())
+        assert bm.default_bin == zero_bin
+
+    def test_few_distinct_values(self):
+        x = np.array([1.0, 2.0, 3.0] * 100)
+        bm = BinMapper()
+        bm.find_bin(x, len(x), max_bin=255)
+        assert bm.num_bin <= 4  # 3 values (+zero handling)
+        b1, b2, b3 = (int(bm.value_to_bin(v)) for v in (1.0, 2.0, 3.0))
+        assert b1 < b2 < b3
+
+    def test_nan_missing(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(2000)
+        x[::5] = np.nan
+        bm = BinMapper()
+        bm.find_bin(x, len(x), max_bin=63)
+        assert bm.missing_type == MissingType.NAN
+        assert int(bm.value_to_bin(np.nan)) == bm.num_bin - 1
+        # non-NaN values never land in the NaN bin
+        assert bm.value_to_bin(x[~np.isnan(x)]).max() < bm.num_bin - 1
+
+    def test_no_use_missing(self):
+        x = np.array([1.0, np.nan, 2.0, 3.0] * 50)
+        bm = BinMapper()
+        bm.find_bin(x, len(x), max_bin=63, use_missing=False)
+        assert bm.missing_type == MissingType.NONE
+        # NaN maps like 0.0
+        assert int(bm.value_to_bin(np.nan)) == int(bm.value_to_bin(0.0))
+
+    def test_zero_as_missing(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(1000)
+        x[::3] = 0.0
+        bm = BinMapper()
+        bm.find_bin(x, len(x), max_bin=63, zero_as_missing=True)
+        assert bm.missing_type == MissingType.ZERO
+
+    def test_trivial_constant(self):
+        # constant feature: killed by pre_filter/NeedFilter (bin.cpp:55),
+        # since {kZeroThreshold, inf} still yields 2 nominal bins
+        bm = BinMapper()
+        bm.find_bin(np.full(100, 7.0), 100, max_bin=255, pre_filter=True)
+        assert bm.is_trivial
+        bm2 = BinMapper()
+        bm2.find_bin(np.zeros(100), 100, max_bin=255)
+        assert bm2.is_trivial  # all-zero: single bin, trivial outright
+
+    def test_min_data_in_bin(self):
+        x = np.arange(100, dtype=np.float64)
+        bm = BinMapper()
+        bm.find_bin(x, len(x), max_bin=255, min_data_in_bin=10)
+        # ~100/10 bins
+        assert bm.num_bin <= 12
+
+
+class TestCategoricalBinning:
+    def test_basic(self):
+        rng = np.random.RandomState(0)
+        cat = rng.choice([0, 1, 2, 5, 99], size=10000,
+                         p=[.4, .3, .2, .05, .05]).astype(float)
+        bm = BinMapper()
+        bm.find_bin(cat, len(cat), max_bin=255, bin_type=BinType.CATEGORICAL)
+        assert bm.bin_type == BinType.CATEGORICAL
+        # bin 0 reserved for NaN/other; most frequent category gets bin 1
+        assert bm.bin_2_categorical[0] == -1
+        assert bm.bin_2_categorical[1] == 0
+        assert int(bm.value_to_bin(0.0)) == 1
+        # negative / unseen -> bin 0
+        assert int(bm.value_to_bin(-3.0)) == 0
+        assert int(bm.value_to_bin(12345.0)) == 0
+        assert int(bm.value_to_bin(np.nan)) == 0
+
+    def test_rare_categories_cut(self):
+        # categories below min_data_in_bin are cut after the first two
+        vals = np.concatenate([np.zeros(5000), np.ones(4000),
+                               np.full(30, 2.0), np.full(2, 3.0)])
+        bm = BinMapper()
+        bm.find_bin(vals, len(vals), max_bin=255,
+                    bin_type=BinType.CATEGORICAL, min_data_in_bin=3)
+        assert 3 in bm.categorical_2_bin or int(bm.value_to_bin(3.0)) == 0
+
+
+class TestBinnedDataset:
+    def test_construct(self):
+        rng = np.random.RandomState(0)
+        data = rng.randn(5000, 10)
+        data[:, 3] = 1.23  # trivial
+        y = rng.rand(5000)
+        ds = BinnedDataset.from_matrix(data, _cfg(), label=y)
+        assert ds.num_data == 5000
+        assert ds.num_features == 9
+        assert ds.used_feature_map == [0, 1, 2, 4, 5, 6, 7, 8, 9]
+        assert ds.bins.dtype == np.uint8
+        assert np.allclose(ds.metadata.label, y.astype(np.float32))
+
+    def test_reference_alignment(self):
+        rng = np.random.RandomState(1)
+        train = rng.randn(2000, 5)
+        valid = rng.randn(500, 5)
+        ds = BinnedDataset.from_matrix(train, _cfg())
+        vs = BinnedDataset.from_matrix(valid, _cfg(), reference=ds)
+        assert vs.bin_mappers is ds.bin_mappers
+        # same value -> same bin under both
+        v = valid[0, 0]
+        assert int(ds.bin_mappers[0].value_to_bin(v)) == int(vs.bins[0, 0])
+
+    def test_group_metadata(self):
+        rng = np.random.RandomState(2)
+        data = rng.randn(100, 3)
+        ds = BinnedDataset.from_matrix(
+            data, _cfg(), label=rng.rand(100), group=[30, 50, 20])
+        np.testing.assert_array_equal(ds.metadata.query_boundaries,
+                                      [0, 30, 80, 100])
+        assert ds.metadata.num_queries == 3
+
+    def test_max_bin_by_feature(self):
+        rng = np.random.RandomState(3)
+        data = rng.randn(3000, 3)
+        ds = BinnedDataset.from_matrix(
+            data, _cfg(max_bin_by_feature=[10, 50, 255]))
+        assert ds.bin_mappers[0].num_bin <= 10
+        assert ds.bin_mappers[1].num_bin <= 50

@@ -1,0 +1,76 @@
+"""Config parsing tests (reference behavior: src/io/config.cpp Config::Set,
+alias handling src/io/config_auto.cpp)."""
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def test_defaults():
+    c = Config.from_params({})
+    assert c.num_leaves == 31
+    assert c.learning_rate == 0.1
+    assert c.num_iterations == 100
+    assert c.max_bin == 255
+    assert c.objective == "regression"
+    assert c.boosting == "gbdt"
+    assert c.min_data_in_leaf == 20
+
+
+def test_aliases():
+    c = Config.from_params({
+        "n_estimators": 10, "eta": 0.3, "min_child_samples": 7,
+        "colsample_bytree": 0.5, "subsample": 0.8, "reg_alpha": 1.0,
+        "reg_lambda": 2.0, "random_state": 42, "num_classes": 1,
+    })
+    assert c.num_iterations == 10
+    assert c.learning_rate == 0.3
+    assert c.min_data_in_leaf == 7
+    assert c.feature_fraction == 0.5
+    assert c.bagging_fraction == 0.8
+    assert c.lambda_l1 == 1.0
+    assert c.lambda_l2 == 2.0
+    assert c.seed == 42
+
+
+def test_objective_aliases():
+    assert Config.from_params({"objective": "mse"}).objective == "regression"
+    assert Config.from_params({"objective": "mae"}).objective == "regression_l1"
+    assert Config.from_params({"objective": "binary"}).objective == "binary"
+    c = Config.from_params({"objective": "softmax", "num_class": 3})
+    assert c.objective == "multiclass"
+    assert c.num_tree_per_iteration == 3
+
+
+def test_metric_aliases():
+    c = Config.from_params({"metric": "auc,binary_logloss,l2"})
+    assert c.metric == ["auc", "binary_logloss", "l2"]
+    c = Config.from_params({"metric": ["mse", "mean_squared_error"]})
+    assert c.metric == ["l2"]
+
+
+def test_goss_boosting_compat():
+    # 'boosting=goss' is the deprecated spelling of the GOSS sample strategy
+    c = Config.from_params({"boosting": "goss"})
+    assert c.boosting == "gbdt"
+    assert c.data_sample_strategy == "goss"
+
+
+def test_validation_errors():
+    with pytest.raises(LightGBMError):
+        Config.from_params({"num_leaves": 1})
+    with pytest.raises(LightGBMError):
+        Config.from_params({"bagging_fraction": 0.0})
+    with pytest.raises(LightGBMError):
+        Config.from_params({"objective": "nonsense"})
+    with pytest.raises(LightGBMError):
+        Config.from_params({"objective": "multiclass"})  # num_class missing
+
+
+def test_string_coercion():
+    c = Config.from_params({"num_leaves": "63", "learning_rate": "0.2",
+                            "extra_trees": "true", "valid": "a.txt,b.txt"})
+    assert c.num_leaves == 63
+    assert c.learning_rate == 0.2
+    assert c.extra_trees is True
+    assert c.valid == ["a.txt", "b.txt"]
