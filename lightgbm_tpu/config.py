@@ -355,19 +355,27 @@ class Config:
         cfg.raw_params = dict(params)
         resolved: Dict[str, Any] = {}
         fields = {f.name: f for f in dataclasses.fields(cls)}
-        for key, value in params.items():
+        # Canonical-name-wins alias transform (reference:
+        # ParameterAlias::KeyAliasTransform, include/LightGBM/config.h:1159 —
+        # a key spelled with the canonical name always overrides aliases;
+        # among multiple aliases the first-sorted one wins).
+        resolved_from: Dict[str, str] = {}
+        for key in sorted(params):
+            value = params[key]
             name = _ALIASES.get(key, key)
-            if name in resolved:
-                # KeepFirstValues semantics: first occurrence wins
-                # (reference: Config::KeepFirstValues, src/io/config.cpp)
-                log.warning("%s is set=%s, %s=%s will be ignored. "
-                            "Current value: %s=%s", name, resolved[name],
-                            key, value, name, resolved[name])
-                continue
             if name not in fields:
                 log.warning("Unknown parameter: %s", key)
                 continue
+            if name in resolved:
+                is_canonical = key == name
+                prev_canonical = resolved_from[name] == name
+                if prev_canonical or not is_canonical:
+                    log.warning("%s is set=%s, %s=%s will be ignored. "
+                                "Current value: %s=%s", name, resolved[name],
+                                key, value, name, resolved[name])
+                    continue
             resolved[name] = value
+            resolved_from[name] = key
         for name, value in resolved.items():
             setattr(cfg, name, _coerce(fields[name], value))
         cfg._post_process()

@@ -406,12 +406,15 @@ class BinMapper:
             iv = np.where(np.isnan(v), -1, v).astype(np.int64)
             cats = np.array([c for c in self.categorical_2_bin if c >= 0],
                             dtype=np.int64)
+            if len(cats) == 0:
+                out = np.zeros(len(iv), dtype=np.int32)
+                return out[0] if scalar else out
             cats.sort()
             bins_for_cats = np.array(
                 [self.categorical_2_bin[int(c)] for c in cats], dtype=np.int32)
             pos = np.searchsorted(cats, iv)
-            pos_clip = np.clip(pos, 0, max(len(cats) - 1, 0))
-            hit = (len(cats) > 0) & (pos < len(cats)) & (cats[pos_clip] == iv)
+            pos_clip = np.clip(pos, 0, len(cats) - 1)
+            hit = (pos < len(cats)) & (cats[pos_clip] == iv)
             out = np.where(hit & (iv >= 0), bins_for_cats[pos_clip], 0).astype(np.int32)
             return out[0] if scalar else out
         nan_mask = np.isnan(v)

@@ -157,6 +157,14 @@ class BinnedDataset:
             else:
                 sample = data
             max_bin_by_feature = config.max_bin_by_feature
+            if max_bin_by_feature:
+                # reference: src/io/dataset_loader.cpp:614-616 CHECK_EQ/CHECK_GT
+                if len(max_bin_by_feature) != num_total_features:
+                    log.fatal("Length of max_bin_by_feature (%d) != number of "
+                              "features (%d)" % (len(max_bin_by_feature),
+                                                 num_total_features))
+                if min(max_bin_by_feature) <= 1:
+                    log.fatal("Each entry of max_bin_by_feature must be > 1")
             mappers: List[BinMapper] = []
             for f in range(num_total_features):
                 bm = BinMapper()

@@ -1,0 +1,181 @@
+"""Best-split scan vs a brute-force NumPy oracle.
+
+Mirrors the reference's strategy of validating learners end-to-end, but at
+unit level: enumerate every (feature, threshold, NaN-direction) candidate in
+plain NumPy and check ops.split.find_best_split returns the argmax.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.io.binning import MissingType
+from lightgbm_tpu.ops.split import (FeatureMeta, SplitParams, SplitInfo,
+                                    calculate_leaf_output, find_best_split,
+                                    leaf_gain, threshold_l1)
+
+
+def make_params(**kw):
+    d = dict(lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=1.0,
+             min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0,
+             max_delta_step=0.0)
+    d.update(kw)
+    return SplitParams(**{k: jnp.float32(v) for k, v in d.items()})
+
+
+def oracle_best(hist, totals, meta, p, feature_mask=None):
+    """Enumerate all candidates in float64."""
+    F, B, _ = hist.shape
+    sg, sh, sc = totals
+    l1, l2 = float(p.lambda_l1), float(p.lambda_l2)
+    mds = float(p.max_delta_step)
+
+    def tl1(s):
+        return np.sign(s) * max(abs(s) - l1, 0.0)
+
+    def out(g, h):
+        o = -tl1(g) / (h + l2)
+        if mds > 0:
+            o = np.clip(o, -mds, mds)
+        return o
+
+    def gain(g, h):
+        o = out(g, h)
+        return -(2 * tl1(g) * o + (h + l2) * o * o)
+
+    best = (-np.inf, None)
+    for f in range(F):
+        if feature_mask is not None and not feature_mask[f]:
+            continue
+        nb = int(meta.num_bin[f])
+        mt = int(meta.missing_type[f])
+        nan_bin = nb - 1
+        t_hi = nb - 2 if mt == MissingType.NAN else nb - 1
+        for t in range(0, t_hi):
+            for variant in ([0, 1] if mt == MissingType.NAN else [0]):
+                lg = hist[f, :t + 1, 0].sum()
+                lh = hist[f, :t + 1, 1].sum()
+                lc = hist[f, :t + 1, 2].sum()
+                if variant == 1:
+                    lg += hist[f, nan_bin, 0]
+                    lh += hist[f, nan_bin, 1]
+                    lc += hist[f, nan_bin, 2]
+                rg, rh, rc = sg - lg, sh - lh, sc - lc
+                if (lc < float(p.min_data_in_leaf) or
+                        rc < float(p.min_data_in_leaf) or
+                        lh < float(p.min_sum_hessian_in_leaf) or
+                        rh < float(p.min_sum_hessian_in_leaf)):
+                    continue
+                g = gain(lg, lh) + gain(rg, rh)
+                if g > best[0]:
+                    best = (g, (f, t, variant))
+    shift = gain(sg, sh) + float(p.min_gain_to_split)
+    return best[0] - shift, best[1]
+
+
+def rand_case(rng, F=5, B=16, missing=None):
+    hist = rng.rand(F, B, 3).astype(np.float32)
+    hist[..., 2] = rng.randint(0, 50, size=(F, B))
+    hist[..., 1] = np.abs(hist[..., 1]) + 0.1
+    num_bin = rng.randint(3, B + 1, size=F).astype(np.int32)
+    for f in range(F):
+        hist[f, num_bin[f]:, :] = 0.0
+    mt = np.full(F, MissingType.NONE, dtype=np.int32)
+    if missing is not None:
+        mt[:] = missing
+    meta = FeatureMeta(num_bin=jnp.asarray(num_bin),
+                       missing_type=jnp.asarray(mt),
+                       zero_bin=jnp.zeros(F, dtype=jnp.int32))
+    totals = (float(hist[0, :, 0].sum()), float(hist[0, :, 1].sum()),
+              float(hist[0, :, 2].sum()))
+    # make every feature's hist consistent with the same totals
+    for f in range(1, F):
+        hist[f] *= 0
+        hist[f, :num_bin[f]] = _redistribute(rng, totals, num_bin[f])
+    return hist, totals, meta
+
+
+def _redistribute(rng, totals, nb):
+    w = rng.rand(nb)
+    w /= w.sum()
+    out = np.zeros((nb, 3), dtype=np.float32)
+    out[:, 0] = totals[0] * w
+    out[:, 1] = totals[1] * w
+    cnt = rng.multinomial(int(totals[2]), w)
+    out[:, 2] = cnt
+    return out
+
+
+@pytest.mark.parametrize("missing", [None, MissingType.NAN])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_matches_oracle(seed, missing):
+    rng = np.random.RandomState(seed)
+    hist, totals, meta = rand_case(rng, missing=missing)
+    p = make_params()
+    info = find_best_split(jnp.asarray(hist), jnp.float32(totals[0]),
+                           jnp.float32(totals[1]), jnp.float32(totals[2]),
+                           meta, p, jnp.ones(hist.shape[0], dtype=bool))
+    og, _ = oracle_best(hist.astype(np.float64), totals, meta, p)
+    assert np.isclose(float(info.gain), og, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("kw", [
+    dict(lambda_l1=0.5), dict(lambda_l2=2.0), dict(max_delta_step=0.1),
+    dict(min_data_in_leaf=30.0), dict(min_gain_to_split=0.2),
+])
+def test_matches_oracle_regularized(kw):
+    rng = np.random.RandomState(7)
+    hist, totals, meta = rand_case(rng)
+    p = make_params(**kw)
+    info = find_best_split(jnp.asarray(hist), jnp.float32(totals[0]),
+                           jnp.float32(totals[1]), jnp.float32(totals[2]),
+                           meta, p, jnp.ones(hist.shape[0], dtype=bool))
+    og, ob = oracle_best(hist.astype(np.float64), totals, meta, p)
+    if ob is None or og <= 0:
+        assert float(info.gain) == -np.inf or float(info.gain) <= 0 \
+            or int(info.feature) == -1
+    else:
+        assert np.isclose(float(info.gain), og, rtol=1e-4, atol=1e-5)
+
+
+def test_feature_mask():
+    rng = np.random.RandomState(3)
+    hist, totals, meta = rand_case(rng)
+    p = make_params()
+    mask = np.zeros(hist.shape[0], dtype=bool)
+    mask[2] = True
+    info = find_best_split(jnp.asarray(hist), jnp.float32(totals[0]),
+                           jnp.float32(totals[1]), jnp.float32(totals[2]),
+                           meta, p, jnp.asarray(mask))
+    assert int(info.feature) in (2, -1)
+    og, ob = oracle_best(hist.astype(np.float64), totals, meta, p,
+                         feature_mask=mask)
+    if ob is not None and og > 0:
+        assert np.isclose(float(info.gain), og, rtol=1e-4, atol=1e-5)
+
+
+def test_no_valid_split():
+    # one bin per feature -> nothing to split
+    hist = np.zeros((2, 4, 3), dtype=np.float32)
+    hist[:, 0] = [1.0, 2.0, 10]
+    meta = FeatureMeta(num_bin=jnp.asarray([1, 1], dtype=jnp.int32),
+                       missing_type=jnp.zeros(2, dtype=jnp.int32),
+                       zero_bin=jnp.zeros(2, dtype=jnp.int32))
+    info = find_best_split(jnp.asarray(hist), jnp.float32(1.0),
+                           jnp.float32(2.0), jnp.float32(10.0),
+                           meta, make_params(), jnp.ones(2, dtype=bool))
+    assert int(info.feature) == -1
+
+
+def test_leaf_output_formulas():
+    p = make_params(lambda_l1=1.0, lambda_l2=3.0)
+    # |g| <= l1 -> zero output
+    assert float(calculate_leaf_output(jnp.float32(0.5), jnp.float32(2.0), p)) == 0.0
+    # g=5,h=2: -(5-1)/(2+3) = -0.8
+    assert np.isclose(float(calculate_leaf_output(
+        jnp.float32(5.0), jnp.float32(2.0), p)), -0.8)
+    p2 = make_params(max_delta_step=0.3)
+    assert np.isclose(float(calculate_leaf_output(
+        jnp.float32(-6.0), jnp.float32(2.0), p2)), 0.3)
+    # unclipped gain == tl1^2/(h+l2)
+    g = float(leaf_gain(jnp.float32(5.0), jnp.float32(2.0), p))
+    assert np.isclose(g, (5 - 1) ** 2 / (2 + 3), rtol=1e-6)

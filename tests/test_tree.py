@@ -1,0 +1,105 @@
+"""Tree model: split mechanics, prediction semantics, text round-trip."""
+import numpy as np
+
+from lightgbm_tpu.io.binning import MissingType
+from lightgbm_tpu.models.tree import Tree, kDefaultLeftMask
+
+
+def build_example():
+    """root: f0 <= 0.5 -> leaf0 else (f1 <= 2.0 -> leaf1 else leaf2)"""
+    t = Tree(max_leaves=4)
+    t.split(leaf=0, feature=0, feature_inner=0, threshold_bin=3,
+            threshold_real=0.5, left_value=1.0, right_value=-1.0,
+            left_count=60, right_count=40, left_weight=6.0, right_weight=4.0,
+            gain=10.0, missing_type=MissingType.NONE, default_left=False)
+    t.split(leaf=1, feature=1, feature_inner=1, threshold_bin=5,
+            threshold_real=2.0, left_value=2.0, right_value=3.0,
+            left_count=25, right_count=15, left_weight=2.5, right_weight=1.5,
+            gain=4.0, missing_type=MissingType.NONE, default_left=False)
+    return t
+
+
+def test_split_mechanics():
+    t = build_example()
+    assert t.num_leaves == 3
+    # node 0 = root, node 1 = second split (was leaf 1)
+    assert t.left_child[0] == ~0
+    assert t.right_child[0] == 1
+    assert t.left_child[1] == ~1
+    assert t.right_child[1] == ~2
+    assert t.internal_count[0] == 100
+    assert t.internal_count[1] == 40
+
+
+def test_predict():
+    t = build_example()
+    X = np.array([[0.0, 0.0],    # left -> leaf0 = 1.0
+                  [1.0, 1.0],    # right, f1<=2 -> leaf1 = 2.0
+                  [1.0, 5.0]])   # right, f1>2  -> leaf2 = 3.0
+    np.testing.assert_allclose(t.predict(X), [1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(t.predict_leaf_index(X), [0, 1, 2])
+
+
+def test_nan_default_direction():
+    t = Tree(max_leaves=2)
+    t.split(0, 0, 0, 1, 0.5, -1.0, 1.0, 5, 5, 1, 1, 1.0,
+            MissingType.NAN, default_left=True)
+    X = np.array([[np.nan], [0.0], [1.0]])
+    np.testing.assert_allclose(t.predict(X), [-1.0, -1.0, 1.0])
+    t2 = Tree(max_leaves=2)
+    t2.split(0, 0, 0, 1, 0.5, -1.0, 1.0, 5, 5, 1, 1, 1.0,
+             MissingType.NAN, default_left=False)
+    np.testing.assert_allclose(t2.predict(X), [1.0, -1.0, 1.0])
+
+
+def test_zero_default_direction():
+    t = Tree(max_leaves=2)
+    # threshold 0.5: zero would naturally go left; default_left=False sends it right
+    t.split(0, 0, 0, 1, 0.5, -1.0, 1.0, 5, 5, 1, 1, 1.0,
+            MissingType.ZERO, default_left=False)
+    X = np.array([[0.0], [np.nan], [0.2], [1.0]])
+    # NaN converted to 0 under ZERO missing -> default direction too
+    np.testing.assert_allclose(t.predict(X), [1.0, 1.0, -1.0, 1.0])
+
+
+def test_shrinkage_and_bias():
+    t = build_example()
+    t.apply_shrinkage(0.1)
+    np.testing.assert_allclose(sorted(t.leaf_value[:3]), [0.1, 0.2, 0.3])
+    assert t.shrinkage == 0.1
+    t.add_bias(1.0)
+    np.testing.assert_allclose(sorted(t.leaf_value[:3]), [1.1, 1.2, 1.3])
+
+
+def test_text_round_trip():
+    t = build_example()
+    t.apply_shrinkage(0.05)
+    s = t.to_string()
+    assert "num_leaves=3" in s
+    t2 = Tree.from_string(s)
+    X = np.random.RandomState(0).randn(50, 2) * 3
+    np.testing.assert_allclose(t.predict(X), t2.predict(X), rtol=1e-12)
+    assert t2.num_leaves == 3
+    assert t2.shrinkage == t.shrinkage
+
+
+def test_single_leaf_round_trip():
+    t = Tree(max_leaves=1)
+    t.leaf_value[0] = 0.25
+    t2 = Tree.from_string(t.to_string())
+    assert t2.num_leaves == 1
+    np.testing.assert_allclose(t2.predict(np.zeros((3, 1))), 0.25)
+
+
+def test_predict_by_bin_matches_real():
+    t = build_example()
+    # binned view: f0 bins 0..7 with threshold_bin 3; f1 threshold_bin 5
+    rng = np.random.RandomState(1)
+    bins = rng.randint(0, 8, size=(100, 2)).astype(np.uint8)
+    meta_missing = np.array([MissingType.NONE, MissingType.NONE])
+    nan_bins = np.array([7, 7])
+    zero_bins = np.array([0, 0])
+    leaf = t.predict_by_bin(bins, nan_bins, zero_bins, meta_missing)
+    # reconstruct real values consistent with bin thresholds
+    X = np.where(bins <= [3, 5], [0.0, 1.0], [1.0, 3.0]).astype(float)
+    np.testing.assert_array_equal(leaf, t.predict_leaf_index(X))
