@@ -83,7 +83,12 @@ class FeatureMeta(NamedTuple):
 
 class SplitInfo(NamedTuple):
     """Best split of one leaf — all 0-d device arrays. The TPU analogue of
-    the reference's POD ``SplitInfo`` (src/treelearner/split_info.hpp:22)."""
+    the reference's POD ``SplitInfo`` (src/treelearner/split_info.hpp:22).
+
+    ``*_count`` are in-bag row counts (what min_data_in_leaf and leaf_count
+    use, matching the reference under bagging); ``*_total_count`` count every
+    partitioned row including out-of-bag ones — the learner sizes its row
+    compaction buffers with these."""
     gain: jnp.ndarray            # f32; relative gain (already minus shift); <=0 => invalid
     feature: jnp.ndarray         # i32 inner feature index; -1 if invalid
     threshold_bin: jnp.ndarray   # i32
@@ -91,10 +96,12 @@ class SplitInfo(NamedTuple):
     left_sum_grad: jnp.ndarray   # f32
     left_sum_hess: jnp.ndarray
     left_count: jnp.ndarray      # f32 (exact for counts < 2^24)
+    left_total_count: jnp.ndarray
     left_output: jnp.ndarray
     right_sum_grad: jnp.ndarray
     right_sum_hess: jnp.ndarray
     right_count: jnp.ndarray
+    right_total_count: jnp.ndarray
     right_output: jnp.ndarray
 
 
@@ -129,6 +136,7 @@ def find_best_split(hist: jnp.ndarray,
                     sum_grad: jnp.ndarray,
                     sum_hess: jnp.ndarray,
                     sum_count: jnp.ndarray,
+                    sum_total_count: jnp.ndarray,
                     meta: FeatureMeta,
                     params: SplitParams,
                     feature_mask: jnp.ndarray) -> SplitInfo:
@@ -136,20 +144,22 @@ def find_best_split(hist: jnp.ndarray,
 
     Parameters
     ----------
-    hist : f32[F, B, 3] — per (feature, bin) sums of (grad, hess, count)
-    sum_grad/sum_hess/sum_count : leaf totals (f32 scalars)
+    hist : f32[F, B, 4] — per (feature, bin) sums of
+        (grad, hess, in-bag count, total count)
+    sum_grad/sum_hess/sum_count/sum_total_count : leaf totals (f32 scalars)
     meta : FeatureMeta (i32[F] arrays)
     params : SplitParams scalars
     feature_mask : bool[F] — feature_fraction / interaction-constraint mask
       (reference: src/treelearner/col_sampler.hpp)
     """
     F, B, _ = hist.shape
-    g, h, c = hist[..., 0], hist[..., 1], hist[..., 2]
+    g, h, c, tc = hist[..., 0], hist[..., 1], hist[..., 2], hist[..., 3]
 
     # Left-side stats for threshold t = sum over bins <= t.
     left_g = jnp.cumsum(g, axis=1)
     left_h = jnp.cumsum(h, axis=1)
     left_c = jnp.cumsum(c, axis=1)
+    left_tc = jnp.cumsum(tc, axis=1)
 
     bin_ids = jnp.arange(B, dtype=jnp.int32)[None, :]            # [1, B]
     num_bin = meta.num_bin[:, None]                              # [F, 1]
@@ -161,6 +171,7 @@ def find_best_split(hist: jnp.ndarray,
     nan_g = jnp.where(is_nan_missing, take(g), 0.0)              # [F]
     nan_h = jnp.where(is_nan_missing, take(h), 0.0)
     nan_c = jnp.where(is_nan_missing, take(c), 0.0)
+    nan_tc = jnp.where(is_nan_missing, take(tc), 0.0)
 
     # Valid thresholds: t <= num_bin - 2 (right side must be reachable); for
     # NaN-missing features the NaN bin itself is not a threshold either
@@ -198,10 +209,13 @@ def find_best_split(hist: jnp.ndarray,
     feature, tbin = (rem // B).astype(jnp.int32), (rem % B).astype(jnp.int32)
 
     # Reconstruct the winning split's stats.
-    lg = left_g[feature, tbin] + jnp.where(variant == 1, nan_g[feature], 0.0)
-    lh = left_h[feature, tbin] + jnp.where(variant == 1, nan_h[feature], 0.0)
-    lc = left_c[feature, tbin] + jnp.where(variant == 1, nan_c[feature], 0.0)
+    is_l = variant == 1
+    lg = left_g[feature, tbin] + jnp.where(is_l, nan_g[feature], 0.0)
+    lh = left_h[feature, tbin] + jnp.where(is_l, nan_h[feature], 0.0)
+    lc = left_c[feature, tbin] + jnp.where(is_l, nan_c[feature], 0.0)
+    ltc = left_tc[feature, tbin] + jnp.where(is_l, nan_tc[feature], 0.0)
     rg, rh, rc = sum_grad - lg, sum_hess - lh, sum_count - lc
+    rtc = sum_total_count - ltc
 
     gain_rel = best_gain_abs - shift
     is_valid = jnp.isfinite(best_gain_abs) & (gain_rel > 0.0)
@@ -217,7 +231,9 @@ def find_best_split(hist: jnp.ndarray,
         threshold_bin=tbin,
         default_left=default_left,
         left_sum_grad=lg, left_sum_hess=lh, left_count=lc,
+        left_total_count=ltc,
         left_output=calculate_leaf_output(lg, lh, params),
         right_sum_grad=rg, right_sum_hess=rh, right_count=rc,
+        right_total_count=rtc,
         right_output=calculate_leaf_output(rg, rh, params),
     )

@@ -1,0 +1,1 @@
+from .serial import SerialTreeLearner  # noqa: F401

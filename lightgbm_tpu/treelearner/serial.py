@@ -1,0 +1,324 @@
+"""Single-chip leaf-wise tree learner.
+
+TPU-native counterpart of the reference's SerialTreeLearner
+(src/treelearner/serial_tree_learner.cpp:159 ``Train``) and, closer in
+spirit, its CUDA whole-loop learner
+(src/treelearner/cuda/cuda_single_gpu_tree_learner.cpp:128): all heavy state
+— binned rows, gradients, per-leaf histograms, the row→leaf partition — is
+device-resident; the host only orchestrates the leaf loop and records the
+chosen splits into the host ``Tree``.
+
+XLA needs static shapes, so the two data-dependent quantities are handled as:
+
+- **row→leaf partition**: a full-length ``leaf_of_row`` vector updated by a
+  vectorized compare on the split feature's bin column (no index lists; the
+  analogue of the reference's DataPartition::Split,
+  src/treelearner/data_partition.hpp:21 / cuda_data_partition.cu:288).
+- **per-leaf row gather**: rows of the leaf to histogram are compacted with
+  ``jnp.nonzero(..., size=S)`` where the static size S is the smaller-child
+  count rounded up to a power of two; one jitted step function is compiled
+  per bucket size (~log2(N) variants, cached). Padding rows point at a
+  dummy row whose (grad, hess, count) are zero so they vanish from sums.
+
+Per split step (one device dispatch, one small host readback):
+  apply pending split -> partition update -> gather smaller child ->
+  histogram it -> sibling by subtraction (serial_tree_learner.cpp:421) ->
+  best-split scan for both children -> argmax over all leaf candidates ->
+  return the next winning split record to the host.
+
+The host loop mirrors the reference's ``Train`` loop: split the best leaf,
+stop when num_leaves is reached or no candidate has positive gain.
+max_depth gating follows BeforeFindBestSplit (serial_tree_learner.cpp:287):
+a leaf at depth d is splittable iff max_depth <= 0 or d < max_depth —
+enforced by zeroing candidate gains at record-creation time.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.binning import MissingType
+from ..io.dataset import BinnedDataset
+from ..models.tree import Tree
+from ..ops.histogram import build_histogram, subtract_histogram
+from ..ops.split import (FeatureMeta, SplitInfo, SplitParams, find_best_split)
+from ..utils import log
+
+_NEG_INF = -jnp.inf
+_MIN_BUCKET = 256
+
+
+def _next_pow2(n: int) -> int:
+    n = max(int(n), 1)
+    return 1 << (n - 1).bit_length()
+
+
+class GrowState(NamedTuple):
+    """Device-resident per-tree state (the analogue of the CUDA learner's
+    CUDALeafSplits + histogram + partition buffers)."""
+    leaf_of_row: jnp.ndarray      # [R] i32 (R = N+1; last row is a dummy, -1)
+    gh: jnp.ndarray               # [R, 4] f32 (grad, hess, in-bag, total=1)
+    hists: jnp.ndarray            # [L, F, B, 4] f32
+    # Per-leaf best-split candidates (SplitInfo fields, array-of-struct):
+    gain: jnp.ndarray             # [L] f32, -inf when invalid
+    feature: jnp.ndarray          # [L] i32
+    threshold_bin: jnp.ndarray    # [L] i32
+    default_left: jnp.ndarray     # [L] bool
+    left_sum_grad: jnp.ndarray    # [L] f32
+    left_sum_hess: jnp.ndarray
+    left_count: jnp.ndarray
+    left_total_count: jnp.ndarray
+    left_output: jnp.ndarray
+    right_sum_grad: jnp.ndarray
+    right_sum_hess: jnp.ndarray
+    right_count: jnp.ndarray
+    right_total_count: jnp.ndarray
+    right_output: jnp.ndarray
+
+
+class SplitRecord(NamedTuple):
+    """One winning split, read back to the host each step."""
+    leaf: jnp.ndarray
+    gain: jnp.ndarray
+    feature: jnp.ndarray
+    threshold_bin: jnp.ndarray
+    default_left: jnp.ndarray
+    left_sum_grad: jnp.ndarray
+    left_sum_hess: jnp.ndarray
+    left_count: jnp.ndarray
+    left_total_count: jnp.ndarray
+    left_output: jnp.ndarray
+    right_sum_grad: jnp.ndarray
+    right_sum_hess: jnp.ndarray
+    right_count: jnp.ndarray
+    right_total_count: jnp.ndarray
+    right_output: jnp.ndarray
+
+
+def _record_at(state: GrowState, leaf) -> SplitRecord:
+    return SplitRecord(
+        leaf=leaf, gain=state.gain[leaf], feature=state.feature[leaf],
+        threshold_bin=state.threshold_bin[leaf],
+        default_left=state.default_left[leaf],
+        left_sum_grad=state.left_sum_grad[leaf],
+        left_sum_hess=state.left_sum_hess[leaf],
+        left_count=state.left_count[leaf],
+        left_total_count=state.left_total_count[leaf],
+        left_output=state.left_output[leaf],
+        right_sum_grad=state.right_sum_grad[leaf],
+        right_sum_hess=state.right_sum_hess[leaf],
+        right_count=state.right_count[leaf],
+        right_total_count=state.right_total_count[leaf],
+        right_output=state.right_output[leaf])
+
+
+def _store_info(state: GrowState, leaf, info: SplitInfo,
+                allowed) -> GrowState:
+    return state._replace(
+        gain=state.gain.at[leaf].set(jnp.where(allowed, info.gain, _NEG_INF)),
+        feature=state.feature.at[leaf].set(info.feature),
+        threshold_bin=state.threshold_bin.at[leaf].set(info.threshold_bin),
+        default_left=state.default_left.at[leaf].set(info.default_left),
+        left_sum_grad=state.left_sum_grad.at[leaf].set(info.left_sum_grad),
+        left_sum_hess=state.left_sum_hess.at[leaf].set(info.left_sum_hess),
+        left_count=state.left_count.at[leaf].set(info.left_count),
+        left_total_count=state.left_total_count.at[leaf].set(
+            info.left_total_count),
+        left_output=state.left_output.at[leaf].set(info.left_output),
+        right_sum_grad=state.right_sum_grad.at[leaf].set(info.right_sum_grad),
+        right_sum_hess=state.right_sum_hess.at[leaf].set(info.right_sum_hess),
+        right_count=state.right_count.at[leaf].set(info.right_count),
+        right_total_count=state.right_total_count.at[leaf].set(
+            info.right_total_count),
+        right_output=state.right_output.at[leaf].set(info.right_output))
+
+
+def _go_left_by_bin(col: jnp.ndarray, tbin, default_left,
+                    missing_type, nan_bin, zero_bin) -> jnp.ndarray:
+    """Training-time split direction over bin values (reference:
+    DenseBin::Split templated missing handling, src/io/dense_bin.hpp)."""
+    gl = col <= tbin
+    gl = jnp.where((missing_type == MissingType.NAN) & (col == nan_bin),
+                   default_left, gl)
+    gl = jnp.where((missing_type == MissingType.ZERO) & (col == zero_bin),
+                   default_left, gl)
+    return gl
+
+
+class SerialTreeLearner:
+    """Leaf-wise grower over a device-resident binned dataset."""
+
+    def __init__(self, config, dataset: BinnedDataset):
+        self.config = config
+        self.dataset = dataset
+        N, F = dataset.bins.shape
+        if F == 0:
+            log.fatal("Cannot train without features")
+        self.N, self.F = N, F
+        self.B = max(int(dataset.max_num_bin), 2)
+        self.L = int(config.num_leaves)
+        self.max_depth = int(config.max_depth)
+        # dummy row N: bins 0, gh 0, leaf -1
+        pad = np.zeros((1, F), dtype=dataset.bins.dtype)
+        self.bins = jnp.asarray(np.concatenate([dataset.bins, pad], axis=0))
+        self.meta = FeatureMeta.from_dataset(dataset)
+        self.params = SplitParams.from_config(config)
+        self._ff_rng = np.random.RandomState(config.feature_fraction_seed)
+        self._step_cache = {}
+        self._root_fn = jax.jit(self._root_impl)
+        self._max_bucket = _next_pow2(N)
+
+    # ------------------------------------------------------------------
+    def _sample_features(self) -> jnp.ndarray:
+        """Per-tree column sampling (reference: ColSampler,
+        src/treelearner/col_sampler.hpp:20)."""
+        ff = float(self.config.feature_fraction)
+        mask = np.ones(self.F, dtype=bool)
+        if 0.0 < ff < 1.0:
+            k = max(1, int(round(self.F * ff)))
+            mask[:] = False
+            mask[self._ff_rng.choice(self.F, k, replace=False)] = True
+        return jnp.asarray(mask)
+
+    # ------------------------------------------------------------------
+    def _root_impl(self, gh: jnp.ndarray, feature_mask: jnp.ndarray,
+                   children_allowed) -> Tuple[GrowState, SplitRecord]:
+        hist = build_histogram(self.bins, gh, self.B)
+        sums = jnp.sum(gh, axis=0)
+        info = find_best_split(hist, sums[0], sums[1], sums[2], sums[3],
+                               self.meta, self.params, feature_mask)
+        L, F, B = self.L, self.F, self.B
+        leaf_of_row = jnp.concatenate([
+            jnp.zeros(self.N, dtype=jnp.int32),
+            jnp.full((1,), -1, dtype=jnp.int32)])
+        zf = lambda: jnp.zeros(L, dtype=jnp.float32)
+        state = GrowState(
+            leaf_of_row=leaf_of_row, gh=gh,
+            hists=jnp.zeros((L, F, B, 4), dtype=jnp.float32).at[0].set(hist),
+            gain=jnp.full(L, _NEG_INF, dtype=jnp.float32),
+            feature=jnp.full(L, -1, dtype=jnp.int32),
+            threshold_bin=jnp.zeros(L, dtype=jnp.int32),
+            default_left=jnp.zeros(L, dtype=bool),
+            left_sum_grad=zf(), left_sum_hess=zf(), left_count=zf(),
+            left_total_count=zf(), left_output=zf(), right_sum_grad=zf(),
+            right_sum_hess=zf(), right_count=zf(), right_total_count=zf(),
+            right_output=zf())
+        state = _store_info(state, 0, info, children_allowed)
+        return state, _record_at(state, 0)
+
+    # ------------------------------------------------------------------
+    def _make_step(self, S: int):
+        meta, params, B = self.meta, self.params, self.B
+        bins = self.bins
+        R = self.N + 1
+
+        def step(state: GrowState, leaf, new_leaf, children_allowed,
+                 feature_mask):
+            f = state.feature[leaf]
+            tbin = state.threshold_bin[leaf]
+            dl = state.default_left[leaf]
+            col = jnp.take(bins, f, axis=1).astype(jnp.int32)
+            gl = _go_left_by_bin(col, tbin, dl, meta.missing_type[f],
+                                 meta.num_bin[f] - 1, meta.zero_bin[f])
+            on_leaf = state.leaf_of_row == leaf
+            leaf_of_row = jnp.where(on_leaf & ~gl, new_leaf,
+                                    state.leaf_of_row)
+
+            lc, rc = state.left_count[leaf], state.right_count[leaf]
+            ltc, rtc = (state.left_total_count[leaf],
+                        state.right_total_count[leaf])
+            smaller_is_left = ltc <= rtc
+            small_id = jnp.where(smaller_is_left, leaf, new_leaf)
+            (idx,) = jnp.nonzero(leaf_of_row == small_id, size=S,
+                                 fill_value=R - 1)
+            hist_small = build_histogram(bins[idx], state.gh[idx], B)
+            hist_large = subtract_histogram(state.hists[leaf], hist_small)
+            hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
+            hist_right = jnp.where(smaller_is_left, hist_large, hist_small)
+            hists = state.hists.at[leaf].set(hist_left) \
+                               .at[new_leaf].set(hist_right)
+
+            left_info = find_best_split(
+                hist_left, state.left_sum_grad[leaf],
+                state.left_sum_hess[leaf], lc, ltc, meta, params,
+                feature_mask)
+            right_info = find_best_split(
+                hist_right, state.right_sum_grad[leaf],
+                state.right_sum_hess[leaf], rc, rtc, meta, params,
+                feature_mask)
+
+            state = state._replace(leaf_of_row=leaf_of_row, hists=hists)
+            state = _store_info(state, leaf, left_info, children_allowed)
+            state = _store_info(state, new_leaf, right_info, children_allowed)
+            best = jnp.argmax(state.gain).astype(jnp.int32)
+            return state, _record_at(state, best)
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def _step_fn(self, S: int):
+        if S not in self._step_cache:
+            self._step_cache[S] = self._make_step(S)
+        return self._step_cache[S]
+
+    def _bucket(self, count: float) -> int:
+        # +1 margin: counts travel as f32 sums and may round down for very
+        # large leaves. The floor caps the number of compiled step variants
+        # at ~log2(N) - 8.
+        return min(max(_next_pow2(int(count) + 1), _MIN_BUCKET),
+                   self._max_bucket)
+
+    # ------------------------------------------------------------------
+    def _splittable(self, depth: int) -> bool:
+        return self.max_depth <= 0 or depth < self.max_depth
+
+    def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
+              bag: Optional[jnp.ndarray] = None
+              ) -> Tuple[Tree, jnp.ndarray]:
+        """Grow one tree. ``grad``/``hess`` are f32[N] device arrays;
+        ``bag`` an optional f32[N] in-bag indicator (0/1). Returns the host
+        Tree and the final [N] row→leaf assignment (device) for score
+        updates (reference: GBDT::UpdateScore uses the learner's partition,
+        src/boosting/gbdt.cpp:475)."""
+        ind = jnp.ones(self.N, dtype=jnp.float32) if bag is None else bag
+        gh = jnp.stack([grad * ind, hess * ind, ind,
+                        jnp.ones(self.N, dtype=jnp.float32)], axis=1)
+        gh = jnp.concatenate(
+            [gh, jnp.zeros((1, 4), dtype=jnp.float32)], axis=0)
+        feature_mask = self._sample_features()
+
+        tree = Tree(self.L)
+        state, rec = self._root_fn(gh, feature_mask, self._splittable(0))
+        pending = jax.device_get(rec)
+        for k in range(1, self.L):
+            leaf = int(pending.leaf)
+            if int(pending.feature) < 0 or not np.isfinite(float(pending.gain)) \
+                    or float(pending.gain) <= 0.0:
+                break
+            f = int(pending.feature)
+            tbin = int(pending.threshold_bin)
+            mapper = self.dataset.bin_mappers[f]
+            tree.split(
+                leaf=leaf, feature=self.dataset.real_feature_index(f),
+                feature_inner=f, threshold_bin=tbin,
+                threshold_real=self.dataset.real_threshold(f, tbin),
+                left_value=float(pending.left_output),
+                right_value=float(pending.right_output),
+                left_count=int(round(float(pending.left_count))),
+                right_count=int(round(float(pending.right_count))),
+                left_weight=float(pending.left_sum_hess),
+                right_weight=float(pending.right_sum_hess),
+                gain=float(pending.gain), missing_type=mapper.missing_type,
+                default_left=bool(pending.default_left))
+            children_allowed = self._splittable(int(tree.leaf_depth[leaf]))
+            smaller = min(float(pending.left_total_count),
+                          float(pending.right_total_count))
+            S = self._bucket(smaller)
+            state, rec = self._step_fn(S)(
+                state, jnp.int32(leaf), jnp.int32(k),
+                jnp.asarray(children_allowed), feature_mask)
+            pending = jax.device_get(rec)
+        return tree, state.leaf_of_row[:self.N]

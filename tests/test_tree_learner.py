@@ -1,0 +1,196 @@
+"""Serial tree learner vs an independent greedy-CART oracle.
+
+The oracle grows a leaf-wise tree in pure NumPy float64 directly from the
+binned matrix with explicit row subsets — no histograms, no subtraction
+trick, no compaction — so it exercises none of the learner's machinery.
+"""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.treelearner.serial import SerialTreeLearner
+
+
+def oracle_tree(bins, num_bin, grad, hess, num_leaves, l2=0.0,
+                min_data=1, min_hess=1e-3, max_depth=-1):
+    """Leaf-wise greedy growth; returns per-row leaf output (float64)."""
+    n = bins.shape[0]
+    rows_of = {0: np.arange(n)}
+    depth = {0: 0}
+
+    def leaf_gain(rows):
+        g, h = grad[rows].sum(), hess[rows].sum()
+        return g * g / (h + l2)
+
+    def best_split(rows):
+        best = (-np.inf, None)
+        for f in range(bins.shape[1]):
+            col = bins[rows, f]
+            for t in range(num_bin[f] - 1):
+                lm = col <= t
+                nl, nr = lm.sum(), (~lm).sum()
+                if nl < min_data or nr < min_data:
+                    continue
+                gl, hl = grad[rows][lm].sum(), hess[rows][lm].sum()
+                gr, hr = grad[rows][~lm].sum(), hess[rows][~lm].sum()
+                if hl < min_hess or hr < min_hess:
+                    continue
+                gain = gl * gl / (hl + l2) + gr * gr / (hr + l2)
+                if gain > best[0]:
+                    best = (gain, (f, t))
+        return best
+
+    cand = {0: best_split(rows_of[0])}
+    next_id = 1
+    while next_id < num_leaves:
+        viable = {l: c for l, c in cand.items()
+                  if c[1] is not None
+                  and (max_depth <= 0 or depth[l] < max_depth)
+                  and c[0] - leaf_gain(rows_of[l]) > 1e-10}
+        if not viable:
+            break
+        l = max(viable, key=lambda k: viable[k][0] - leaf_gain(rows_of[k]))
+        f, t = viable[l][1]
+        rows = rows_of[l]
+        lm = bins[rows, f] <= t
+        rows_of[l], rows_of[next_id] = rows[lm], rows[~lm]
+        depth[next_id] = depth[l] + 1
+        depth[l] += 1
+        cand[l] = best_split(rows_of[l])
+        cand[next_id] = best_split(rows_of[next_id])
+        next_id += 1
+    out = np.zeros(n)
+    for l, rows in rows_of.items():
+        g, h = grad[rows].sum(), hess[rows].sum()
+        out[rows] = -g / (h + l2)
+    return out, len(rows_of)
+
+
+def _setup(seed=0, n=800, f=5, max_bin=16, **params):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] * 1.5 + np.sin(X[:, 1] * 2) + 0.2 * rng.randn(n))
+    p = dict(max_bin=max_bin, min_data_in_leaf=1,
+             min_sum_hessian_in_leaf=1e-3, min_data_in_bin=1, verbose=-1)
+    p.update(params)
+    cfg = Config.from_params(p)
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    grad = (0.0 - y).astype(np.float32)
+    hess = np.ones(n, dtype=np.float32)
+    return cfg, ds, grad, hess, X, y
+
+
+@pytest.mark.parametrize("num_leaves", [2, 8, 31])
+def test_matches_oracle(num_leaves):
+    cfg, ds, grad, hess, X, y = _setup(num_leaves=num_leaves)
+    learner = SerialTreeLearner(cfg, ds)
+    import jax.numpy as jnp
+    tree, leaf_of_row = learner.train(jnp.asarray(grad), jnp.asarray(hess))
+    pred = tree.leaf_value[np.asarray(leaf_of_row)]
+    oracle_pred, oracle_leaves = oracle_tree(
+        ds.bins.astype(np.int64), np.asarray(ds.num_bin_per_feature),
+        grad.astype(np.float64), hess.astype(np.float64), num_leaves)
+    assert tree.num_leaves == oracle_leaves
+    np.testing.assert_allclose(pred, oracle_pred, rtol=2e-3, atol=2e-3)
+
+
+def test_max_depth():
+    cfg, ds, grad, hess, X, y = _setup(num_leaves=64, max_depth=3)
+    import jax.numpy as jnp
+    learner = SerialTreeLearner(cfg, ds)
+    tree, _ = learner.train(jnp.asarray(grad), jnp.asarray(hess))
+    assert tree.num_leaves <= 8
+    assert tree.leaf_depth[:tree.num_leaves].max() <= 3
+    oracle_pred, oracle_leaves = oracle_tree(
+        ds.bins.astype(np.int64), np.asarray(ds.num_bin_per_feature),
+        grad.astype(np.float64), hess.astype(np.float64), 64, max_depth=3)
+    assert tree.num_leaves == oracle_leaves
+
+
+def test_min_data_in_leaf():
+    cfg, ds, grad, hess, X, y = _setup(num_leaves=32, min_data_in_leaf=50)
+    import jax.numpy as jnp
+    learner = SerialTreeLearner(cfg, ds)
+    tree, _ = learner.train(jnp.asarray(grad), jnp.asarray(hess))
+    assert tree.num_leaves > 1
+    assert tree.leaf_count[:tree.num_leaves].min() >= 50
+    assert tree.leaf_count[:tree.num_leaves].sum() == ds.num_data
+
+
+def test_partition_matches_tree_predict():
+    cfg, ds, grad, hess, X, y = _setup(num_leaves=16)
+    import jax.numpy as jnp
+    learner = SerialTreeLearner(cfg, ds)
+    tree, leaf_of_row = learner.train(jnp.asarray(grad), jnp.asarray(hess))
+    nb = np.asarray(ds.num_bin_per_feature)
+    mt = np.array([m.missing_type for m in ds.bin_mappers])
+    zb = np.array([m.default_bin for m in ds.bin_mappers])
+    leaf_via_tree = tree.predict_by_bin(ds.bins, nb - 1, zb, mt)
+    np.testing.assert_array_equal(np.asarray(leaf_of_row), leaf_via_tree)
+    # real-value prediction agrees with bin-space partition
+    np.testing.assert_array_equal(tree.predict_leaf_index(X), leaf_via_tree)
+
+
+def test_bagging_indicator():
+    cfg, ds, grad, hess, X, y = _setup(num_leaves=8)
+    import jax.numpy as jnp
+    rng = np.random.RandomState(1)
+    bag = (rng.rand(len(y)) < 0.7).astype(np.float32)
+    learner = SerialTreeLearner(cfg, ds)
+    tree, leaf_of_row = learner.train(jnp.asarray(grad), jnp.asarray(hess),
+                                      bag=jnp.asarray(bag))
+    # counts reflect only in-bag rows; all rows still partitioned
+    assert tree.leaf_count[:tree.num_leaves].sum() == int(bag.sum())
+    assert len(np.asarray(leaf_of_row)) == len(y)
+    # oracle on the bagged subset
+    sel = bag.astype(bool)
+    remap = -np.ones(len(y), dtype=np.int64)
+    remap[sel] = np.arange(sel.sum())
+    oracle_pred, oracle_leaves = oracle_tree(
+        ds.bins[sel].astype(np.int64), np.asarray(ds.num_bin_per_feature),
+        grad[sel].astype(np.float64), hess[sel].astype(np.float64), 8)
+    assert tree.num_leaves == oracle_leaves
+    pred = tree.leaf_value[np.asarray(leaf_of_row)][sel]
+    np.testing.assert_allclose(pred, oracle_pred, rtol=2e-3, atol=2e-3)
+
+
+def test_deterministic():
+    import jax.numpy as jnp
+    cfg, ds, grad, hess, X, y = _setup(num_leaves=16)
+    t1, _ = SerialTreeLearner(cfg, ds).train(jnp.asarray(grad), jnp.asarray(hess))
+    t2, _ = SerialTreeLearner(cfg, ds).train(jnp.asarray(grad), jnp.asarray(hess))
+    assert t1.to_string() == t2.to_string()
+
+
+def test_nan_data():
+    rng = np.random.RandomState(2)
+    n = 500
+    X = rng.randn(n, 4)
+    X[rng.rand(n, 4) < 0.2] = np.nan
+    y = np.where(np.isnan(X[:, 0]), 3.0, np.nan_to_num(X[:, 0]))
+    cfg = Config.from_params(dict(max_bin=32, min_data_in_leaf=1,
+                                  min_data_in_bin=1, num_leaves=8,
+                                  verbose=-1))
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    import jax.numpy as jnp
+    learner = SerialTreeLearner(cfg, ds)
+    tree, leaf_of_row = learner.train(
+        jnp.asarray((0.0 - y).astype(np.float32)),
+        jnp.asarray(np.ones(n, dtype=np.float32)))
+    # the partition and the real-valued predict must agree on NaN routing
+    np.testing.assert_array_equal(
+        tree.predict_leaf_index(X), np.asarray(leaf_of_row))
+    # fitting y (driven by NaN-ness of col 0) should be near-perfect
+    pred = tree.leaf_value[np.asarray(leaf_of_row)]
+    assert np.mean((y - pred) ** 2) < 0.05 * np.var(y)
+
+
+def test_feature_fraction():
+    import jax.numpy as jnp
+    cfg, ds, grad, hess, X, y = _setup(num_leaves=8, feature_fraction=0.4,
+                                       seed=5)
+    learner = SerialTreeLearner(cfg, ds)
+    tree, _ = learner.train(jnp.asarray(grad), jnp.asarray(hess))
+    used = set(tree.split_feature[:tree.num_internal].tolist())
+    assert len(used) <= 2  # 5 features * 0.4 = 2 allowed per tree
