@@ -1,0 +1,99 @@
+"""Benchmark: Higgs-shaped boosting throughput on one chip.
+
+Baseline anchor (BASELINE.md): reference CPU trains Higgs (10.5M rows x 28
+features, num_leaves=255, max_bin=255) at 500 iters / 130.094 s ≈ 3.84
+iters/s on 16 threads (reference: docs/Experiments.rst:105-155). The real
+Higgs set is not fetchable here (zero egress), so this bench generates a
+Higgs-shaped synthetic binary problem (continuous physics-like features)
+and measures steady-state boosting iterations/sec with the reference's
+benchmark settings, scaled by default to 1M rows to keep round time
+bounded (rows/sec is reported alongside; override with BENCH_ROWS).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def make_higgs_like(n_rows: int, n_features: int = 28, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    X = np.empty((n_rows, n_features), dtype=np.float32)
+    chunk = 1 << 20
+    w = rng.randn(n_features).astype(np.float32) * 0.6
+    for lo in range(0, n_rows, chunk):
+        hi = min(lo + chunk, n_rows)
+        block = rng.randn(hi - lo, n_features).astype(np.float32)
+        # heavy-tailed momentum-like columns
+        block[:, ::4] = np.abs(block[:, ::4]) ** 1.5
+        X[lo:hi] = block
+    logit = X @ w + 0.5 * np.sin(X[:, 0]) * X[:, 1]
+    y = (logit + rng.randn(n_rows).astype(np.float32) * 0.5 > 0).astype(
+        np.float64)
+    return X, y
+
+
+def main() -> None:
+    n_rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    n_iters = int(os.environ.get("BENCH_ITERS", 60))
+    warmup = int(os.environ.get("BENCH_WARMUP", 10))
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.boosting import create_boosting
+
+    X, y = make_higgs_like(n_rows)
+    params = {
+        "objective": "binary", "num_leaves": 255, "max_bin": 255,
+        "learning_rate": 0.1, "metric": "auc", "verbosity": -1,
+        "min_data_in_leaf": 100, "num_iterations": n_iters,
+    }
+    cfg = Config.from_params(params)
+    t0 = time.time()
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    t_bin = time.time() - t0
+
+    booster = create_boosting(cfg, ds)
+    # warmup: compile all step-bucket variants
+    t0 = time.time()
+    for _ in range(warmup):
+        booster.train_one_iter()
+    t_warm = time.time() - t0
+
+    t0 = time.time()
+    for _ in range(n_iters - warmup):
+        booster.train_one_iter()
+    # force completion of async device work
+    np.asarray(booster.train_score)
+    t_train = time.time() - t0
+
+    iters_per_sec = (n_iters - warmup) / t_train
+    from lightgbm_tpu.metric import create_metric
+    m = create_metric("auc", cfg)
+    m.init(ds.metadata, ds.num_data)
+    auc = m.eval(np.asarray(booster.train_score[:, 0]),
+                 booster.objective)[0]
+
+    baseline_iters_per_sec = 500.0 / 130.094  # reference CPU Higgs
+    # scale for row count: baseline is 10.5M rows; iters/sec scales ~1/rows
+    scale = n_rows / 10_500_000.0
+    effective = iters_per_sec * scale
+    result = {
+        "metric": "higgs_like_boosting_iters_per_sec_per_chip",
+        "value": round(iters_per_sec, 4),
+        "unit": "iters/s (%.0fk rows x 28f, 255 leaves, 255 bins; "
+                "train AUC %.6f; binning %.1fs, warmup %.1fs)"
+                % (n_rows / 1000.0, auc, t_bin, t_warm),
+        "vs_baseline": round(effective / baseline_iters_per_sec, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""lightgbm_tpu — a TPU-native gradient-boosted decision tree framework.
+
+A from-scratch reimplementation of the capabilities of LightGBM
+(reference mounted at /root/reference) designed for TPU execution:
+histogram construction, split search and partitioning run as XLA/Pallas
+programs over device-resident binned data; distributed training shards
+rows over a ``jax.sharding.Mesh`` and reduces histograms with ICI
+collectives. The Python surface mirrors the reference's
+``lightgbm`` package (Dataset/Booster/train/cv/sklearn wrappers).
+"""
+from .basic import Booster, Dataset, LightGBMError
+from .callback import (EarlyStopException, early_stopping, log_evaluation,
+                       record_evaluation, reset_parameter)
+from .config import Config
+from .engine import CVBooster, cv, train
+from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Dataset", "Booster", "LightGBMError", "Config",
+    "train", "cv", "CVBooster",
+    "early_stopping", "log_evaluation", "record_evaluation",
+    "reset_parameter", "EarlyStopException",
+    "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
+]

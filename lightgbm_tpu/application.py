@@ -1,0 +1,176 @@
+"""CLI application: train / predict / refit / save_binary over config files.
+
+Equivalent of the reference's ``Application``
+(reference: src/application/application.cpp — LoadParameters at :50,
+LoadData at :88, InitTrain at :167, Train at :209, Predict at :221;
+``main`` at src/main.cpp:11). Accepts the same ``key=value`` argument and
+config-file conventions, including ``config=train.conf``.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config
+from .utils import log
+
+
+def parse_args(argv: List[str]) -> Dict[str, str]:
+    """key=value args + optional config file (reference:
+    Application::LoadParameters, application.cpp:50-86: command line takes
+    precedence over config file, first value wins per source)."""
+    cli: Dict[str, str] = {}
+    for arg in argv:
+        if "=" not in arg:
+            log.warning("Unknown argument: %s" % arg)
+            continue
+        k, v = arg.split("=", 1)
+        k = k.strip().lstrip("-")
+        if k not in cli:
+            cli[k] = v.strip().strip('"').strip("'")
+    params: Dict[str, str] = {}
+    conf_path = cli.get("config", cli.get("config_file", ""))
+    if conf_path:
+        for line in open(conf_path):
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            k, v = k.strip(), v.strip().strip('"').strip("'")
+            if k not in params:
+                params[k] = v
+    params.update(cli)  # CLI wins
+    return params
+
+
+def _load_tabular(path: str, config: Config):
+    """Load CSV/TSV/LibSVM text data (reference: Parser::CreateParser
+    auto-detection, src/io/parser.cpp; label column conventions of
+    config.h:691)."""
+    header = None
+    with open(path) as f:
+        first = f.readline().rstrip("\n")
+    delim = "\t" if "\t" in first else ","
+    tokens = first.split(delim)
+    is_libsvm = all(":" in t for t in tokens[1:2]) and ":" in first
+    has_header = bool(config.header)
+    if is_libsvm:
+        rows, labels = [], []
+        max_idx = -1
+        for line in open(path):
+            parts = line.split()
+            if not parts:
+                continue
+            labels.append(float(parts[0]))
+            feats = {}
+            for kv in parts[1:]:
+                i, v = kv.split(":")
+                feats[int(i)] = float(v)
+                max_idx = max(max_idx, int(i))
+            rows.append(feats)
+        X = np.zeros((len(rows), max_idx + 1))
+        for r, feats in enumerate(rows):
+            for i, v in feats.items():
+                X[r, i] = v
+        return X, np.asarray(labels), None
+    data = np.genfromtxt(path, delimiter=delim,
+                         skip_header=1 if has_header else 0)
+    if data.ndim == 1:
+        data = data.reshape(1, -1)
+    label_col = 0
+    lc = str(config.label_column)
+    if lc.startswith("name:"):
+        name = lc[5:]
+        cols = first.split(delim)
+        label_col = cols.index(name)
+    elif lc not in ("", "0"):
+        label_col = int(lc)
+    y = data[:, label_col]
+    X = np.delete(data, label_col, axis=1)
+    weights = None
+    wc = str(config.weight_column)
+    if wc and wc not in ("",):
+        widx = int(wc) if not wc.startswith("name:") else None
+        if widx is not None:
+            # weight column index is post-label-removal per reference docs
+            weights = X[:, widx]
+            X = np.delete(X, widx, axis=1)
+    return X, y, weights
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    """reference: Application::Run (include/LightGBM/application.h:79)."""
+    argv = sys.argv[1:] if argv is None else argv
+    params = parse_args(argv)
+    config = Config.from_params(params)
+    task = config.task
+
+    if task == "train":
+        X, y, w = _load_tabular(config.data, config)
+        ds = Dataset(X, label=y, weight=w, params=params)
+        valid_sets = []
+        valid_names = []
+        for i, vpath in enumerate(
+                v for v in str(config.valid).split(",") if v):
+            Xv, yv, wv = _load_tabular(vpath, config)
+            valid_sets.append(Dataset(Xv, label=yv, weight=wv,
+                                      reference=ds, params=params))
+            valid_names.append("valid_%d" % i)
+        from .engine import train as train_fn
+        init_model = config.input_model or None
+        booster = train_fn(params, ds,
+                           num_boost_round=config.num_iterations,
+                           valid_sets=valid_sets, valid_names=valid_names,
+                           init_model=init_model)
+        out = config.output_model or "LightGBM_model.txt"
+        booster.save_model(out)
+        log.info("Finished training; model saved to %s" % out)
+        return 0
+
+    if task in ("predict", "prediction", "test"):
+        booster = Booster(params=params, model_file=config.input_model)
+        X, _, _ = _load_tabular(config.data, config)
+        pred = booster.predict(
+            X, raw_score=bool(config.predict_raw_score),
+            pred_leaf=bool(config.predict_leaf_index),
+            pred_contrib=bool(config.predict_contrib),
+            start_iteration=config.start_iteration_predict,
+            num_iteration=config.num_iteration_predict or None)
+        out = config.output_result or "LightGBM_predict_result.txt"
+        np.savetxt(out, np.asarray(pred), fmt="%.18g", delimiter="\t")
+        log.info("Finished prediction; results saved to %s" % out)
+        return 0
+
+    if task == "refit":
+        booster = Booster(params=params, model_file=config.input_model)
+        X, y, _ = _load_tabular(config.data, config)
+        new_booster = booster  # refit leaves with new data
+        from .boosting.refit import refit_model
+        refit_model(new_booster.inner, X, y,
+                    decay_rate=config.refit_decay_rate)
+        out = config.output_model or "LightGBM_model.txt"
+        new_booster.save_model(out)
+        return 0
+
+    if task == "save_binary":
+        X, y, w = _load_tabular(config.data, config)
+        ds = Dataset(X, label=y, weight=w, params=params)
+        ds.construct()
+        from .io.binary_io import save_binary
+        save_binary(ds.handle, config.data + ".bin")
+        log.info("Saved binary dataset to %s.bin" % config.data)
+        return 0
+
+    log.fatal("Unknown task: %s" % task)
+    return 1
+
+
+def main() -> None:
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

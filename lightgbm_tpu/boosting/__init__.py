@@ -1,0 +1,32 @@
+"""Boosting layer: GBDT / DART / RF drivers + sampling strategies.
+
+Factory equivalent of the reference's ``Boosting::CreateBoosting``
+(reference: include/LightGBM/boosting.h:314, src/boosting/boosting.cpp).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils import log
+from .dart import DART
+from .gbdt import GBDT
+from .rf import RF
+from .sample_strategy import (BaggingStrategy, GOSSStrategy, SampleStrategy,
+                              create_sample_strategy)
+
+
+def create_boosting(config, train_data=None, objective=None) -> GBDT:
+    """boosting ∈ {gbdt, dart, rf, goss(legacy)}."""
+    name = config.boosting
+    if name in ("gbdt", "gbrt", "goss"):
+        return GBDT(config, train_data, objective)
+    if name == "dart":
+        return DART(config, train_data, objective)
+    if name in ("rf", "random_forest"):
+        return RF(config, train_data, objective)
+    log.fatal("Unknown boosting type %s" % name)
+
+
+__all__ = ["GBDT", "DART", "RF", "create_boosting",
+           "create_sample_strategy", "SampleStrategy", "BaggingStrategy",
+           "GOSSStrategy"]

@@ -1,0 +1,120 @@
+"""DART boosting — dropout trees.
+
+TPU-native equivalent of the reference's ``DART``
+(reference: src/boosting/dart.hpp:23): each iteration randomly drops a
+subset of existing trees, trains on the score with those trees removed,
+then normalizes the dropped trees and the new tree so the expected score
+is preserved (dart.hpp:158 ``Normalize``).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import log
+from .gbdt import GBDT
+
+
+class DART(GBDT):
+    submodel_name = "tree"  # same model format
+
+    def __init__(self, config, train_data, objective=None):
+        super().__init__(config, train_data, objective)
+        self.drop_rng = np.random.RandomState(config.drop_seed)
+        self.drop_index: List[int] = []
+        self.sum_weight = 0.0
+        self.tree_weight: List[float] = []
+        # DART ignores shrinkage on score updates; normalization handles it
+        self.shrinkage_rate = 1.0
+        log.info("Using DART")
+
+    # -- select + remove dropped trees -----------------------------------
+    def _select_dropping_trees(self) -> None:
+        """reference: DART::DroppingTrees (dart.hpp:97)."""
+        self.drop_index = []
+        num_iters = self.iter
+        if num_iters <= 0:
+            return
+        cfg = self.config
+        if cfg.uniform_drop:
+            rate = cfg.drop_rate
+            keep = self.drop_rng.random_sample(num_iters) >= rate
+            self.drop_index = [i for i in range(num_iters) if not keep[i]]
+        else:
+            # weighted by tree weight (normalized trees drop less often)
+            w = np.asarray(self.tree_weight)
+            p = w / w.sum() * cfg.drop_rate * num_iters
+            u = self.drop_rng.random_sample(num_iters)
+            self.drop_index = [i for i in range(num_iters) if u[i] < p[i]]
+        if cfg.max_drop > 0 and len(self.drop_index) > cfg.max_drop:
+            self.drop_rng.shuffle(self.drop_index)
+            self.drop_index = sorted(self.drop_index[:cfg.max_drop])
+        if self.drop_rng.random_sample() < cfg.skip_drop:
+            self.drop_index = []
+
+    def _apply_trees(self, iters: List[int], sign: float) -> None:
+        """Add (+1) or remove (-1) the given iterations' trees from all
+        scores via host binned traversal."""
+        K = self.num_tree_per_iteration
+        for it in iters:
+            for k in range(K):
+                tree = self.models[it * K + k]
+                leaf = tree.predict_by_bin(self.train_data.bins,
+                                           *self._bin_meta)
+                delta = (sign * tree.leaf_value[leaf]).astype(np.float32)
+                self.train_score = self.train_score.at[:, k].add(
+                    jnp.asarray(delta))
+                for vd in self.valid_data:
+                    vleaf = tree.predict_by_bin(vd.dataset.bins,
+                                                *self._bin_meta)
+                    vd.scores[:, k] += sign * tree.leaf_value[vleaf]
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        self._select_dropping_trees()
+        if self.drop_index:
+            self._apply_trees(self.drop_index, -1.0)
+        n_models_before = len(self.models)
+        res = super().train_one_iter(grad, hess)
+        if len(self.models) > n_models_before:
+            self._normalize()
+        elif self.drop_index:
+            # no new tree was trained: restore the dropped trees as-is
+            self._apply_trees(self.drop_index, 1.0)
+        return res
+
+    def _normalize(self) -> None:
+        """reference: DART::Normalize (dart.hpp:158): new tree scaled by
+        lr/(k+lr) (or xgboost mode 1/(k+lr)); dropped trees scaled by
+        k/(k+lr) and re-added."""
+        cfg = self.config
+        K = self.num_tree_per_iteration
+        k_drop = len(self.drop_index)
+        lr = float(cfg.learning_rate)
+        if cfg.xgboost_dart_mode:
+            new_weight = lr / (k_drop + lr)
+            old_factor = k_drop / (k_drop + lr)
+        else:
+            if k_drop == 0:
+                new_weight, old_factor = lr, 1.0
+            else:
+                new_weight = lr / k_drop / (1.0 + lr / k_drop)
+                old_factor = 1.0 / (1.0 + lr / k_drop)
+        # the unscaled new tree was already added to scores at weight 1;
+        # correct the scores by (new_weight - 1) of its contribution, then
+        # scale the stored tree to match
+        self._apply_trees([self.iter - 1], new_weight - 1.0)
+        for k in range(K):
+            tree = self.models[-K + k]
+            if tree.num_leaves >= 1:
+                tree.apply_shrinkage(new_weight)
+        # rescale dropped trees and re-add at their new weight
+        for it in self.drop_index:
+            for k in range(K):
+                self.models[it * K + k].apply_shrinkage(old_factor)
+            self.tree_weight[it] *= old_factor
+        if self.drop_index:
+            self._apply_trees(self.drop_index, 1.0)
+        self.tree_weight.append(new_weight)
+        self.sum_weight += new_weight

@@ -1,0 +1,642 @@
+"""GBDT boosting driver.
+
+TPU-native equivalent of the reference's ``GBDT``
+(reference: src/boosting/gbdt.cpp; interface include/LightGBM/boosting.h:27).
+Division of labor on TPU: the per-iteration hot path (gradients, sampling,
+tree growth, score update) runs on device; the host orchestrates iterations
+and keeps the model (list of host ``Tree``s), mirroring the CUDA build where
+``boosting_on_gpu_`` keeps gradients/scores device-resident
+(reference: src/boosting/gbdt.cpp:102, src/boosting/cuda/cuda_score_updater.*).
+
+Training score update uses the learner's final row→leaf partition — a
+device gather of the tree's leaf values — rather than re-walking the tree
+(the trick the reference's CUDADataPartition::UpdateTrainScore uses,
+src/treelearner/cuda/cuda_data_partition.cu).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..io.binning import MissingType
+from ..io.dataset import BinnedDataset
+from ..metric import Metric, create_metric, resolve_metric_names
+from ..models.tree import Tree
+from ..objective import ObjectiveFunction, create_objective
+from ..treelearner import create_tree_learner
+from ..utils import log
+from .sample_strategy import create_sample_strategy
+
+kEpsilon = 1e-15
+_K_MIN_SCORE = -np.inf
+
+
+class ValidData:
+    """One validation set: binned rows aligned with the training mappers +
+    incrementally maintained scores (reference: GBDT::AddValidDataset,
+    gbdt.cpp:182, ScoreUpdater per valid set)."""
+
+    def __init__(self, dataset: BinnedDataset, metrics: List[Metric],
+                 num_tree_per_iteration: int):
+        self.dataset = dataset
+        self.metrics = metrics
+        self.scores = np.zeros((dataset.num_data, num_tree_per_iteration),
+                               dtype=np.float64)
+        if dataset.metadata.init_score is not None:
+            init = np.asarray(dataset.metadata.init_score, dtype=np.float64)
+            self.scores += init.reshape(num_tree_per_iteration, -1).T
+
+    def add_tree(self, tree: Tree, class_id: int, bin_meta) -> None:
+        leaf = tree.predict_by_bin(self.dataset.bins, *bin_meta)
+        if tree.is_linear and self.dataset.raw_data is not None:
+            from ..models.linear import linear_predict
+            self.scores[:, class_id] += linear_predict(
+                tree, self.dataset.raw_data, leaf)
+        else:
+            self.scores[:, class_id] += tree.leaf_value[leaf]
+
+    def add_const(self, val: float, class_id: int) -> None:
+        self.scores[:, class_id] += val
+
+
+class GBDT:
+    """reference: src/boosting/gbdt.cpp (Init at :52, Train at :229,
+    TrainOneIter at :334)."""
+
+    submodel_name = "tree"
+
+    def __init__(self, config: Config, train_data: Optional[BinnedDataset],
+                 objective: Optional[ObjectiveFunction] = None):
+        self.config = config
+        self.train_data = train_data
+        self.objective: Optional[ObjectiveFunction] = objective
+        self.models: List[Tree] = []
+        self.iter = 0
+        self.num_init_iteration = 0
+        self.best_iteration = -1
+        self.shrinkage_rate = float(config.learning_rate)
+        self.average_output = False
+        self.loaded_parameter = ""
+
+        if config.objective in ("multiclass", "multiclassova"):
+            self.num_class = int(config.num_class)
+        else:
+            self.num_class = 1
+
+        if train_data is not None:
+            self._init_train(train_data)
+        else:
+            # prediction-only booster (model loaded from string)
+            self.num_tree_per_iteration = self.num_class
+            self.max_feature_idx = 0
+            self.feature_names: List[str] = []
+            self.feature_infos: List[str] = []
+            self.label_idx = 0
+            self.monotone_constraints: List[int] = []
+
+    # ------------------------------------------------------------------
+    def _init_train(self, train_data: BinnedDataset) -> None:
+        config = self.config
+        if self.objective is None and config.objective not in (
+                "custom", "none"):
+            self.objective = create_objective(config.objective, config)
+        if self.objective is not None:
+            self.objective.init(train_data.metadata, train_data.num_data)
+            self.num_tree_per_iteration = \
+                self.objective.num_model_per_iteration
+        else:
+            self.num_tree_per_iteration = self.num_class
+        self.num_data = train_data.num_data
+        self.max_feature_idx = train_data.num_total_features - 1
+        self.feature_names = list(train_data.feature_names)
+        self.feature_infos = train_data.feature_infos()
+        self.label_idx = 0
+        mc = train_data.monotone_constraints
+        self.monotone_constraints = (
+            [] if mc is None else [int(v) for v in np.asarray(mc)])
+
+        self.learner = create_tree_learner(config, train_data)
+        self.sample_strategy = create_sample_strategy(
+            config, self.num_data, self.num_tree_per_iteration)
+        self.sample_strategy.reset_metadata(train_data.metadata)
+
+        K = self.num_tree_per_iteration
+        score = np.zeros((self.num_data, K), dtype=np.float32)
+        self._has_init_score = train_data.metadata.init_score is not None
+        if self._has_init_score:
+            init = np.asarray(train_data.metadata.init_score,
+                              dtype=np.float64)
+            score += init.reshape(K, -1).T.astype(np.float32)
+        self.train_score = jnp.asarray(score)
+
+        self.class_need_train = [True] * K
+        if self.objective is not None:
+            self.class_need_train = [
+                self.objective.class_need_train(k) for k in range(K)]
+
+        # metrics over training data (is_provide_training_metric)
+        self.train_metrics: List[Metric] = []
+        if config.is_provide_training_metric:
+            for name in resolve_metric_names(
+                    config, config.objective):
+                m = create_metric(name, config)
+                if m is not None:
+                    m.init(train_data.metadata, train_data.num_data)
+                    self.train_metrics.append(m)
+
+        self.valid_data: List[ValidData] = []
+        # early-stopping state per (valid set, metric):
+        self._best_score: List[List[float]] = []
+        self._best_iter: List[List[int]] = []
+        self._best_msg: List[List[str]] = []
+
+        # cached per-feature bin metadata for host-side binned traversal
+        ds = train_data
+        self._bin_meta = (
+            np.asarray([m.num_bin - 1 for m in ds.bin_mappers],
+                       dtype=np.int32),
+            np.asarray([m.default_bin for m in ds.bin_mappers],
+                       dtype=np.int32),
+            np.asarray([m.missing_type for m in ds.bin_mappers],
+                       dtype=np.int32),
+        )
+
+    # ------------------------------------------------------------------
+    def add_valid_data(self, valid_data: BinnedDataset,
+                       names: Optional[List[str]] = None) -> None:
+        """reference: GBDT::AddValidDataset (gbdt.cpp:182)."""
+        metrics = []
+        for name in resolve_metric_names(self.config, self.config.objective):
+            m = create_metric(name, self.config)
+            if m is not None:
+                m.init(valid_data.metadata, valid_data.num_data)
+                metrics.append(m)
+        vd = ValidData(valid_data, metrics, self.num_tree_per_iteration)
+        # replay existing model
+        for i in range(self.iter + self.num_init_iteration):
+            for k in range(self.num_tree_per_iteration):
+                idx = i * self.num_tree_per_iteration + k
+                if idx < len(self.models):
+                    vd.add_tree(self.models[idx], k, self._bin_meta)
+        self.valid_data.append(vd)
+        n_metrics = len(metrics)
+        if self.config.first_metric_only:
+            n_metrics = min(n_metrics, 1)
+        self._best_score.append([_K_MIN_SCORE] * n_metrics)
+        self._best_iter.append([0] * n_metrics)
+        self._best_msg.append([""] * n_metrics)
+
+    # ------------------------------------------------------------------
+    def _boost_from_average(self, class_id: int) -> float:
+        """reference: GBDT::BoostFromAverage (gbdt.cpp:309)."""
+        if (self.models or self._has_init_score or self.objective is None):
+            return 0.0
+        if self.config.boost_from_average \
+                or self.train_data.num_features == 0:
+            init_score = self.objective.boost_from_score(class_id)
+            if abs(init_score) > kEpsilon:
+                self._add_const_score(init_score, class_id)
+                log.info("Start training from score %f" % init_score)
+                return init_score
+        elif self.objective.name in ("regression_l1", "quantile", "mape"):
+            log.warning("Disabling boost_from_average in %s may cause the "
+                        "slow convergence" % self.objective.name)
+        return 0.0
+
+    def _add_const_score(self, val: float, class_id: int) -> None:
+        self.train_score = self.train_score.at[:, class_id].add(
+            np.float32(val))
+        for vd in self.valid_data:
+            vd.add_const(val, class_id)
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration (reference: GBDT::TrainOneIter,
+        gbdt.cpp:334). Returns True when training should stop (no
+        splittable leaves anywhere)."""
+        K = self.num_tree_per_iteration
+        init_scores = [0.0] * K
+        if grad is None or hess is None:
+            if self.objective is None:
+                log.fatal("No objective function provided")
+            for k in range(K):
+                init_scores[k] = self._boost_from_average(k)
+            score = self.train_score[:, 0] if K == 1 else self.train_score
+            g, h = self.objective.get_gradients(score)
+        else:
+            g = jnp.asarray(np.asarray(grad, dtype=np.float32))
+            h = jnp.asarray(np.asarray(hess, dtype=np.float32))
+            if K > 1:
+                g = g.reshape(K, self.num_data).T
+                h = h.reshape(K, self.num_data).T
+        if K > 1 and g.ndim == 1:
+            g = g.reshape(K, self.num_data).T
+            h = h.reshape(K, self.num_data).T
+
+        g, h, bag = self.sample_strategy.bagging(self.iter, g, h)
+
+        should_continue = False
+        new_trees = []
+        for k in range(K):
+            gk = g if K == 1 else g[:, k]
+            hk = h if K == 1 else h[:, k]
+            tree: Optional[Tree] = None
+            if self.class_need_train[k] and self.train_data.num_features > 0:
+                tree, leaf_of_row = self.learner.train(gk, hk, bag)
+            if tree is not None and tree.num_leaves > 1:
+                should_continue = True
+                if self.config.linear_tree:
+                    # piecewise-linear leaves (reference:
+                    # LinearTreeLearner::CalculateLinear,
+                    # src/treelearner/linear_tree_learner.cpp:173)
+                    from ..models.linear import fit_linear_leaves
+                    if self.train_data.raw_data is None:
+                        log.fatal("linear_tree requires raw data; "
+                                  "construct the Dataset with "
+                                  "keep_raw_data=True")
+                    # raw_data keeps ALL original columns, and
+                    # tree.split_feature holds real column indices, so
+                    # path features index raw_data directly
+                    fit_linear_leaves(
+                        tree, self.train_data.raw_data,
+                        np.asarray(gk), np.asarray(hk),
+                        np.asarray(leaf_of_row),
+                        float(self.config.linear_lambda),
+                        None if bag is None else np.asarray(bag) > 0)
+                if (self.objective is not None
+                        and self.objective.is_renew_tree_output):
+                    score_np = np.asarray(
+                        self.train_score[:, k], dtype=np.float64)
+                    leaf_np = np.asarray(leaf_of_row)
+                    mask = (None if bag is None
+                            else np.asarray(bag) > 0)
+                    self.objective.renew_tree_output(
+                        tree, score_np, leaf_np, mask)
+                tree.apply_shrinkage(self.shrinkage_rate)
+                self._update_score(tree, leaf_of_row, k)
+                if abs(init_scores[k]) > kEpsilon:
+                    tree.add_bias(init_scores[k])
+            else:
+                # constant tree the first iteration (reference:
+                # gbdt.cpp:407-418)
+                if len(self.models) < K:
+                    if (self.objective is not None
+                            and not self.config.boost_from_average
+                            and not self._has_init_score):
+                        init_scores[k] = \
+                            self.objective.boost_from_score(k)
+                        self._add_const_score(init_scores[k], k)
+                    tree = Tree(1)
+                    tree.leaf_value[0] = init_scores[k]
+                else:
+                    tree = Tree(1)
+            new_trees.append(tree)
+
+        if not should_continue:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            if len(self.models) >= K:
+                return True
+            # keep the constant trees of the very first iteration
+            self.models.extend(new_trees)
+            self.iter += 1
+            return True
+
+        self.models.extend(new_trees)
+        self.iter += 1
+        return False
+
+    # ------------------------------------------------------------------
+    def _update_score(self, tree: Tree, leaf_of_row: jnp.ndarray,
+                      class_id: int) -> None:
+        """Device gather of leaf outputs over the learner's final
+        partition (reference: GBDT::UpdateScore, gbdt.cpp:475)."""
+        if tree.is_linear:
+            # linear leaves need raw features → host prediction
+            from ..models.linear import linear_predict
+            delta = jnp.asarray(linear_predict(
+                tree, self.train_data.raw_data,
+                np.asarray(leaf_of_row)).astype(np.float32))
+        else:
+            leaf_values = jnp.asarray(
+                tree.leaf_value[:max(tree.num_leaves, 1)].astype(
+                    np.float32))
+            delta = leaf_values[leaf_of_row]
+        self.train_score = self.train_score.at[:, class_id].add(delta)
+        for vd in self.valid_data:
+            vd.add_tree(tree, class_id, self._bin_meta)
+
+    # ------------------------------------------------------------------
+    def rollback_one_iter(self) -> None:
+        """reference: GBDT::RollbackOneIter (gbdt.cpp:438)."""
+        if self.iter <= 0:
+            return
+        K = self.num_tree_per_iteration
+        for k in range(K):
+            tree = self.models[-K + k]
+            # subtract the tree's contribution by re-walking the binned
+            # training rows (host traversal; rollback is rare)
+            leaf = tree.predict_by_bin(self.train_data.bins, *self._bin_meta)
+            delta = self._tree_row_outputs(tree, self.train_data, leaf)
+            self.train_score = self.train_score.at[:, k].add(
+                jnp.asarray(-delta.astype(np.float32)))
+            for vd in self.valid_data:
+                vleaf = tree.predict_by_bin(vd.dataset.bins, *self._bin_meta)
+                vd.scores[:, k] -= self._tree_row_outputs(
+                    tree, vd.dataset, vleaf)
+        del self.models[-K:]
+        self.iter -= 1
+
+    @staticmethod
+    def _tree_row_outputs(tree: Tree, dataset: BinnedDataset,
+                          leaf: np.ndarray) -> np.ndarray:
+        """Per-row output of one tree over a binned dataset — linear
+        leaves included (used by rollback/DART score adjustments)."""
+        if tree.is_linear and dataset.raw_data is not None:
+            from ..models.linear import linear_predict
+            return linear_predict(tree, dataset.raw_data, leaf)
+        return tree.leaf_value[leaf]
+
+    # ------------------------------------------------------------------
+    def eval_metrics(self) -> List[Tuple[str, str, float, bool]]:
+        """Evaluate all metrics; returns (dataset_name, metric_name,
+        value, is_bigger_better) tuples."""
+        out = []
+        if self.train_metrics:
+            score = np.asarray(self.train_score, dtype=np.float64)
+            score = score[:, 0] if self.num_tree_per_iteration == 1 \
+                else score
+            for m in self.train_metrics:
+                for name, v in zip(m.name, m.eval(score, self.objective)):
+                    out.append(("training", name, v,
+                                m.factor_to_bigger_better > 0))
+        for i, vd in enumerate(self.valid_data):
+            score = vd.scores[:, 0] if self.num_tree_per_iteration == 1 \
+                else vd.scores
+            for m in vd.metrics:
+                for name, v in zip(m.name, m.eval(score, self.objective)):
+                    out.append(("valid_%d" % i, name, v,
+                                m.factor_to_bigger_better > 0))
+        return out
+
+    def _check_early_stopping(self) -> bool:
+        """reference: GBDT::OutputMetric early-stopping bookkeeping
+        (gbdt.cpp:535)."""
+        if self.config.early_stopping_round <= 0 or not self.valid_data:
+            return False
+        stop = False
+        for i, vd in enumerate(self.valid_data):
+            score = vd.scores[:, 0] if self.num_tree_per_iteration == 1 \
+                else vd.scores
+            tracked = 0
+            for m in vd.metrics:
+                if tracked >= len(self._best_score[i]):
+                    break
+                vals = m.eval(score, self.objective)
+                factor = m.factor_to_bigger_better
+                # track only the metric's first value (reference uses
+                # vec_min/vec_max over eval_at; first is standard)
+                cur = vals[0] * (1.0 if factor > 0 else -1.0)
+                if cur > self._best_score[i][tracked]:
+                    self._best_score[i][tracked] = cur
+                    self._best_iter[i][tracked] = self.iter
+                elif (self.iter - self._best_iter[i][tracked]
+                        >= self.config.early_stopping_round):
+                    stop = True
+                tracked += 1
+        if stop:
+            best = max(b for bi in self._best_iter for b in bi)
+            self.best_iteration = best
+            log.info("Early stopping at iteration %d, the best iteration "
+                     "round is %d" % (self.iter, best))
+        return stop
+
+    # ------------------------------------------------------------------
+    def train(self, snapshot_freq: int = -1,
+              model_output_path: str = "",
+              callbacks: Optional[Sequence[Callable]] = None) -> None:
+        """Full training loop (reference: GBDT::Train, gbdt.cpp:229)."""
+        for it in range(self.iter, int(self.config.num_iterations)):
+            finished = self.train_one_iter()
+            if not finished and self.config.metric_freq > 0 \
+                    and (self.iter) % self.config.metric_freq == 0:
+                for ds, name, v, _ in self.eval_metrics():
+                    log.info("Iteration:%d, %s %s : %g"
+                             % (self.iter, ds, name, v))
+                if self._check_early_stopping():
+                    # drop the over-trained models
+                    K = self.num_tree_per_iteration
+                    n_drop = (self.iter - self.best_iteration)
+                    del self.models[len(self.models) - n_drop * K:]
+                    self.iter = self.best_iteration
+                    finished = True
+            if snapshot_freq > 0 and self.iter % snapshot_freq == 0 \
+                    and model_output_path:
+                self.save_model(model_output_path
+                                + ".snapshot_iter_%d" % self.iter)
+            if finished:
+                break
+
+    # ------------------------------------------------------------------
+    # Prediction over raw feature matrices (host)
+    # ------------------------------------------------------------------
+    def _used_models(self, start_iteration: int = 0,
+                     num_iteration: int = -1) -> List[Tree]:
+        K = self.num_tree_per_iteration
+        total_iter = len(self.models) // K
+        start = max(0, min(start_iteration, total_iter))
+        end = total_iter if num_iteration <= 0 \
+            else min(start + num_iteration, total_iter)
+        return self.models[start * K:end * K]
+
+    def predict_raw(self, X: np.ndarray, start_iteration: int = 0,
+                    num_iteration: int = -1) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float64)
+        K = self.num_tree_per_iteration
+        out = np.zeros((X.shape[0], K), dtype=np.float64)
+        models = self._used_models(start_iteration, num_iteration)
+        for i, tree in enumerate(models):
+            out[:, i % K] += tree.predict(X)
+        if self.average_output and models:
+            out /= max(len(models) // K, 1)
+        return out[:, 0] if K == 1 else out
+
+    def predict(self, X: np.ndarray, raw_score: bool = False,
+                start_iteration: int = 0,
+                num_iteration: int = -1) -> np.ndarray:
+        raw = self.predict_raw(X, start_iteration, num_iteration)
+        if raw_score or self.objective is None:
+            return raw
+        return self.objective.convert_output(raw)
+
+    def predict_leaf_index(self, X: np.ndarray, start_iteration: int = 0,
+                           num_iteration: int = -1) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float64)
+        models = self._used_models(start_iteration, num_iteration)
+        out = np.zeros((X.shape[0], len(models)), dtype=np.int32)
+        for i, tree in enumerate(models):
+            out[:, i] = tree.predict_leaf_index(X)
+        return out
+
+    def predict_contrib(self, X: np.ndarray, start_iteration: int = 0,
+                        num_iteration: int = -1) -> np.ndarray:
+        """SHAP contributions (reference: predict_contrib /
+        Tree::PredictContrib, tree.h:139)."""
+        from ..models.shap import predict_contrib as _pc
+        models = self._used_models(start_iteration, num_iteration)
+        return _pc(models, X, self.max_feature_idx + 1,
+                   self.num_tree_per_iteration)
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type: str = "split",
+                           num_iteration: int = -1) -> np.ndarray:
+        """reference: GBDT::FeatureImportance
+        (src/boosting/gbdt_model_text.cpp:680+)."""
+        n = self.max_feature_idx + 1
+        imp = np.zeros(n, dtype=np.float64)
+        for tree in self._used_models(0, num_iteration):
+            ni = tree.num_internal
+            for j in range(ni):
+                f = tree.split_feature[j]
+                if importance_type == "split":
+                    imp[f] += 1.0
+                else:
+                    imp[f] += max(tree.split_gain[j], 0.0)
+        return imp
+
+    # ------------------------------------------------------------------
+    # Model text I/O (reference: src/boosting/gbdt_model_text.cpp)
+    # ------------------------------------------------------------------
+    def save_model_to_string(self, start_iteration: int = 0,
+                             num_iteration: int = -1,
+                             importance_type: str = "split") -> str:
+        """reference: GBDT::SaveModelToString
+        (gbdt_model_text.cpp:311-408)."""
+        lines = [self.submodel_name, "version=v3",
+                 "num_class=%d" % self.num_class,
+                 "num_tree_per_iteration=%d" % self.num_tree_per_iteration,
+                 "label_index=%d" % self.label_idx,
+                 "max_feature_idx=%d" % self.max_feature_idx]
+        if self.objective is not None:
+            lines.append("objective=%s" % self.objective.to_string())
+        if self.average_output:
+            lines.append("average_output")
+        lines.append("feature_names=" + " ".join(self.feature_names))
+        if self.monotone_constraints:
+            lines.append("monotone_constraints="
+                         + " ".join(str(v)
+                                    for v in self.monotone_constraints))
+        lines.append("feature_infos=" + " ".join(self.feature_infos))
+
+        models = self._used_models(start_iteration, num_iteration)
+        tree_strs = []
+        tree_sizes = []
+        for i, tree in enumerate(models):
+            s = "Tree=%d\n%s\n" % (i, tree.to_string())
+            tree_strs.append(s)
+            tree_sizes.append(len(s))
+        lines.append("tree_sizes=" + " ".join(str(s) for s in tree_sizes))
+        lines.append("")
+        out = "\n".join(lines) + "\n"
+        out += "".join(tree_strs)
+        out += "end of trees\n"
+        imp = self.feature_importance("split", num_iteration)
+        pairs = [(int(imp[i]), self.feature_names[i])
+                 for i in range(len(imp)) if imp[i] > 0]
+        pairs.sort(key=lambda p: -p[0])
+        out += "\nfeature_importances:\n"
+        for v, name in pairs:
+            out += "%s=%d\n" % (name, v)
+        out += "\nparameters:\n%s\nend of parameters\n" % \
+            self.config.to_param_string()
+        return out
+
+    def save_model(self, filename: str, start_iteration: int = 0,
+                   num_iteration: int = -1) -> None:
+        with open(filename, "w") as f:
+            f.write(self.save_model_to_string(start_iteration,
+                                              num_iteration))
+
+    def load_model_from_string(self, s: str) -> None:
+        """reference: GBDT::LoadModelFromString
+        (gbdt_model_text.cpp:421)."""
+        from ..objective import load_objective_from_string
+        lines = s.splitlines()
+        kv: Dict[str, str] = {}
+        i = 0
+        while i < len(lines) and not lines[i].startswith("Tree="):
+            line = lines[i]
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k] = v
+            elif line.strip() == "average_output":
+                self.average_output = True
+            i += 1
+        self.num_class = int(kv.get("num_class", 1))
+        self.num_tree_per_iteration = int(
+            kv.get("num_tree_per_iteration", self.num_class))
+        self.label_idx = int(kv.get("label_index", 0))
+        self.max_feature_idx = int(kv.get("max_feature_idx", 0))
+        self.feature_names = kv.get("feature_names", "").split()
+        self.feature_infos = kv.get("feature_infos", "").split()
+        if "objective" in kv:
+            self.objective = load_objective_from_string(
+                kv["objective"], self.config)
+        # parse trees
+        self.models = []
+        cur: List[str] = []
+        in_tree = False
+        for line in lines[i:]:
+            if line.startswith("Tree="):
+                if cur:
+                    self.models.append(Tree.from_string("\n".join(cur)))
+                cur = []
+                in_tree = True
+            elif line.strip() == "end of trees":
+                if cur:
+                    self.models.append(Tree.from_string("\n".join(cur)))
+                cur = []
+                in_tree = False
+            elif in_tree:
+                cur.append(line)
+        self.num_init_iteration = \
+            len(self.models) // max(self.num_tree_per_iteration, 1)
+        self.iter = 0
+
+    @property
+    def current_iteration(self) -> int:
+        return len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    # ------------------------------------------------------------------
+    def align_trees_to_dataset(self, dataset: BinnedDataset) -> None:
+        """Restore bin-space node fields (split_feature_inner,
+        threshold_in_bin, categorical bin masks) on text-loaded trees so
+        binned traversal works for continued training (reference:
+        continued training re-links the loaded model to the Dataset's
+        bin mappers via Tree's train-time fields)."""
+        from ..models.tree import kCategoricalMask
+        for tree in self.models:
+            for node in range(tree.num_internal):
+                real_f = int(tree.split_feature[node])
+                inner = dataset.inner_feature_index(real_f)
+                tree.split_feature_inner[node] = max(inner, 0)
+                if inner < 0:
+                    continue
+                mapper = dataset.bin_mappers[inner]
+                if tree.decision_type[node] & kCategoricalMask:
+                    cat_idx = int(tree.threshold_in_bin[node])
+                    nb = mapper.num_bin
+                    cats = np.array(
+                        [mapper.bin_2_categorical[b] if
+                         b < len(mapper.bin_2_categorical) else -1
+                         for b in range(nb)], dtype=np.float64)
+                    tree.cat_bin_masks[node] = \
+                        tree._cat_contains(cat_idx, cats)
+                else:
+                    tree.threshold_in_bin[node] = mapper.value_to_bin(
+                        np.array([tree.threshold[node]]))[0]

@@ -1,0 +1,66 @@
+"""Refit: update an existing model's leaf values on new data.
+
+Equivalent of the reference's ``GBDT::RefitTree``
+(reference: src/boosting/gbdt.cpp:250; leaf renewal closed form from
+feature_histogram.hpp ``CalculateSplittedLeafOutput``; decay mixing per
+``refit_decay_rate``, config.h:524).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..config import Config
+from ..io.dataset import BinnedDataset
+from ..objective import create_objective
+from ..utils import log
+
+
+def refit_model(gbdt, X: np.ndarray, y: np.ndarray,
+                decay_rate: float = 0.9) -> None:
+    """Refit ``gbdt``'s trees on (X, y): tree structures stay, each leaf
+    output becomes ``decay*old + (1-decay)*shrinkage*new`` where ``new``
+    is the regularized optimum over the new rows landing in that leaf."""
+    X = np.asarray(X, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    config = gbdt.config
+    objective = gbdt.objective
+    if objective is None:
+        objective = create_objective(config.objective, config)
+    from ..io.dataset import Metadata
+    md = Metadata(len(y))
+    md.set_label(y)
+    objective.init(md, len(y))
+
+    K = gbdt.num_tree_per_iteration
+    score = np.zeros((len(y), K), dtype=np.float64)
+    import jax.numpy as jnp
+    lambda_l1 = float(config.lambda_l1)
+    lambda_l2 = float(config.lambda_l2)
+
+    for i, tree in enumerate(gbdt.models):
+        k = i % K
+        sc = score[:, 0] if K == 1 else score
+        g, h = objective.get_gradients(
+            jnp.asarray(sc.astype(np.float32)))
+        g = np.asarray(g, dtype=np.float64)
+        h = np.asarray(h, dtype=np.float64)
+        if K > 1:
+            g, h = g[:, k], h[:, k]
+        leaf_idx = tree.predict_leaf_index(X)
+        for leaf in range(tree.num_leaves):
+            rows = leaf_idx == leaf
+            if not rows.any():
+                continue
+            sg, sh = g[rows].sum(), h[rows].sum()
+            out = -_threshold_l1(sg, lambda_l1) / (sh + lambda_l2)
+            if config.max_delta_step > 0:
+                out = np.clip(out, -config.max_delta_step,
+                              config.max_delta_step)
+            new_val = (decay_rate * tree.leaf_value[leaf]
+                       + (1.0 - decay_rate) * gbdt.shrinkage_rate * out)
+            tree.set_leaf_output(leaf, new_val)
+        score[:, k] += tree.leaf_value[leaf_idx]
+
+
+def _threshold_l1(s: float, l1: float) -> float:
+    return np.sign(s) * max(abs(s) - l1, 0.0)

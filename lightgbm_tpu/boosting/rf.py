@@ -1,0 +1,99 @@
+"""Random-forest mode.
+
+TPU-native equivalent of the reference's ``RF`` (reference:
+src/boosting/rf.hpp:25): bagging-only ensemble, no shrinkage, gradients
+always computed at the constant init score (one-time ``Boosting()``), the
+maintained score is the running *average* of tree outputs
+(``average_output``), and each tree gets the init score baked in via
+AddBias.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.tree import Tree
+from ..utils import log
+from .gbdt import GBDT, kEpsilon
+
+
+class RF(GBDT):
+    def __init__(self, config, train_data, objective=None):
+        has_bag = (config.bagging_freq > 0
+                   and 0.0 < config.bagging_fraction < 1.0) \
+            or (0.0 < config.feature_fraction < 1.0)
+        if not has_bag:
+            log.fatal("Random forest needs bagging_freq + bagging_fraction "
+                      "< 1 or feature_fraction < 1")
+        super().__init__(config, train_data, objective)
+        self.average_output = True
+        self.shrinkage_rate = 1.0
+        if self.objective is None:
+            log.fatal("RF mode does not support custom objective function, "
+                      "please use built-in objectives.")
+        # one-time gradient computation at the init score
+        self.init_scores = [self._rf_init_score(k)
+                            for k in range(self.num_tree_per_iteration)]
+        K = self.num_tree_per_iteration
+        const_score = jnp.asarray(
+            np.tile(np.asarray(self.init_scores, dtype=np.float32),
+                    (self.num_data, 1)))
+        score = const_score[:, 0] if K == 1 else const_score
+        self._grad, self._hess = self.objective.get_gradients(score)
+
+    def _rf_init_score(self, class_id: int) -> float:
+        if self.config.boost_from_average \
+                or self.train_data.num_features == 0:
+            return self.objective.boost_from_score(class_id)
+        return 0.0
+
+    def _multiply_score(self, factor: float, class_id: int) -> None:
+        self.train_score = self.train_score.at[:, class_id].multiply(
+            np.float32(factor))
+        for vd in self.valid_data:
+            vd.scores[:, class_id] *= factor
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        assert grad is None and hess is None, \
+            "RF does not take external gradients"
+        K = self.num_tree_per_iteration
+        g, h, bag = self.sample_strategy.bagging(
+            self.iter, self._grad, self._hess)
+        for k in range(K):
+            gk = g if K == 1 else g[:, k]
+            hk = h if K == 1 else h[:, k]
+            tree: Optional[Tree] = None
+            leaf_of_row = None
+            if self.class_need_train[k] and self.train_data.num_features > 0:
+                tree, leaf_of_row = self.learner.train(gk, hk, bag)
+            if tree is not None and tree.num_leaves > 1:
+                if self.objective.is_renew_tree_output:
+                    pred = self.init_scores[k]
+                    score_np = np.full(self.num_data, pred)
+                    mask = None if bag is None else np.asarray(bag) > 0
+                    self.objective.renew_tree_output(
+                        tree, score_np, np.asarray(leaf_of_row), mask)
+                if abs(self.init_scores[k]) > kEpsilon:
+                    tree.add_bias(self.init_scores[k])
+                denom = self.iter + self.num_init_iteration
+                self._multiply_score(denom, k)
+                self._update_score(tree, leaf_of_row, k)
+                self._multiply_score(1.0 / (denom + 1), k)
+            else:
+                if len(self.models) < K:
+                    out = 0.0
+                    if not self.class_need_train[k]:
+                        out = self.objective.boost_from_score(k)
+                    tree = Tree(1)
+                    tree.leaf_value[0] = out
+                    denom = self.iter + self.num_init_iteration
+                    self._multiply_score(denom, k)
+                    self._add_const_score(out, k)
+                    self._multiply_score(1.0 / (denom + 1), k)
+                elif tree is None:
+                    tree = Tree(1)
+            self.models.append(tree)
+        self.iter += 1
+        return False

@@ -1,0 +1,137 @@
+"""Row sampling strategies: bagging and GOSS.
+
+TPU-native equivalents of the reference's ``SampleStrategy`` family
+(reference: src/boosting/sample_strategy.cpp:12 factory,
+src/boosting/bagging.hpp:26, src/boosting/goss.hpp:30). The reference
+produces a compacted ``bag_data_indices`` list consumed by the learner;
+dynamic-length index lists don't fit XLA's static shapes, so here a
+strategy returns a full-length f32 in-bag indicator (0/1) plus possibly
+rescaled (grad, hess) — the learner multiplies gradients by the indicator
+and counts in-bag rows via its histogram count channel, which is the same
+masked-row trick the CUDA learner's bagging path uses.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import log
+
+
+class SampleStrategy:
+    """No-op default: every row in bag."""
+
+    is_hessian_change = False
+
+    def __init__(self, config, num_data: int, num_tree_per_iteration: int):
+        self.config = config
+        self.num_data = num_data
+        self.num_tree_per_iteration = num_tree_per_iteration
+
+    def reset_metadata(self, metadata) -> None:
+        pass
+
+    def bagging(self, iter_idx: int, grad: jnp.ndarray, hess: jnp.ndarray
+                ) -> Tuple[jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray]]:
+        """Returns (grad, hess, bag) — bag is None for all-rows."""
+        return grad, hess, None
+
+
+class BaggingStrategy(SampleStrategy):
+    """Random row subsampling every ``bagging_freq`` iterations
+    (reference: bagging.hpp:26-110; balanced pos/neg variant at :88-103,
+    :180-195)."""
+
+    def __init__(self, config, num_data, num_tree_per_iteration):
+        super().__init__(config, num_data, num_tree_per_iteration)
+        self.rng = np.random.RandomState(config.bagging_seed)
+        self.balanced = (config.pos_bagging_fraction < 1.0
+                         or config.neg_bagging_fraction < 1.0)
+        self._is_pos: Optional[np.ndarray] = None
+        self._bag: Optional[jnp.ndarray] = None
+
+    def reset_metadata(self, metadata) -> None:
+        if self.balanced:
+            self._is_pos = np.asarray(metadata.label) > 0
+
+    def _resample(self) -> jnp.ndarray:
+        u = self.rng.random_sample(self.num_data)
+        if self.balanced and self._is_pos is not None:
+            frac = np.where(self._is_pos, self.config.pos_bagging_fraction,
+                            self.config.neg_bagging_fraction)
+        else:
+            frac = self.config.bagging_fraction
+        return jnp.asarray((u < frac).astype(np.float32))
+
+    def bagging(self, iter_idx, grad, hess):
+        freq = max(int(self.config.bagging_freq), 1)
+        if self._bag is None or iter_idx % freq == 0:
+            self._bag = self._resample()
+        return grad, hess, self._bag
+
+
+class GOSSStrategy(SampleStrategy):
+    """Gradient-based one-side sampling (reference: goss.hpp:30-165):
+    keep the top ``top_rate`` rows by sum_k |grad_k * hess_k|, sample the
+    rest with probability other_k/(cnt-top_k), amplify sampled small-grad
+    rows' (grad, hess) by (cnt-top_k)/other_k. Skipped while
+    iter < 1/learning_rate (goss.hpp:33)."""
+
+    is_hessian_change = True
+
+    def __init__(self, config, num_data, num_tree_per_iteration):
+        super().__init__(config, num_data, num_tree_per_iteration)
+        if config.top_rate + config.other_rate > 1.0:
+            log.fatal("top_rate + other_rate must be <= 1.0 for GOSS")
+        if config.top_rate <= 0.0 or config.other_rate <= 0.0:
+            log.fatal("top_rate and other_rate must be positive for GOSS")
+        if config.bagging_freq > 0 and config.bagging_fraction != 1.0:
+            log.fatal("Cannot use bagging in GOSS")
+        log.info("Using GOSS")
+        self._key = jax.random.PRNGKey(config.bagging_seed)
+        self.top_k = max(1, int(num_data * config.top_rate))
+        self.other_k = max(1, int(num_data * config.other_rate))
+
+    @partial(jax.jit, static_argnums=0)
+    def _goss(self, grad, hess, key):
+        # grad/hess: [N] or [N, K]
+        g2 = jnp.abs(grad * hess)
+        w = g2 if g2.ndim == 1 else jnp.sum(g2, axis=1)
+        n = w.shape[0]
+        thresh = jax.lax.top_k(w, self.top_k)[0][-1]
+        is_top = w >= thresh
+        multiply = (n - self.top_k) / self.other_k
+        prob = self.other_k / jnp.maximum(n - self.top_k, 1)
+        u = jax.random.uniform(key, (n,))
+        sampled = (~is_top) & (u < prob)
+        bag = (is_top | sampled).astype(jnp.float32)
+        scale = jnp.where(sampled, multiply, 1.0)
+        if grad.ndim > 1:
+            scale = scale[:, None]
+        return grad * scale, hess * scale, bag
+
+    def bagging(self, iter_idx, grad, hess):
+        if iter_idx < int(1.0 / max(self.config.learning_rate, 1e-12)):
+            return grad, hess, None
+        self._key, sub = jax.random.split(self._key)
+        return self._goss(grad, hess, sub)
+
+
+def create_sample_strategy(config, num_data: int,
+                           num_tree_per_iteration: int) -> SampleStrategy:
+    """reference: SampleStrategy::CreateSampleStrategy
+    (src/boosting/sample_strategy.cpp:12): GOSS either as
+    data_sample_strategy=goss or legacy boosting=goss."""
+    if (config.data_sample_strategy == "goss"
+            or config.boosting == "goss"):
+        return GOSSStrategy(config, num_data, num_tree_per_iteration)
+    balanced = (config.pos_bagging_fraction < 1.0
+                or config.neg_bagging_fraction < 1.0)
+    if ((config.bagging_fraction < 1.0 or balanced)
+            and config.bagging_freq > 0):
+        return BaggingStrategy(config, num_data, num_tree_per_iteration)
+    return SampleStrategy(config, num_data, num_tree_per_iteration)

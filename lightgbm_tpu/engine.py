@@ -1,0 +1,304 @@
+"""Training entry points: ``train`` and ``cv``.
+
+API-shaped after the reference's python-package/lightgbm/engine.py
+(``train`` at :36 — dataset construction, callback orchestration
+:204-271, update loop :252, early stop via exception; ``cv`` at :516 with
+``CVBooster`` :280 and fold construction ``_make_n_folds`` :432).
+"""
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .config import Config
+from .utils import log
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          feval: Optional[Union[Callable, List[Callable]]] = None,
+          init_model: Optional[Union[str, Booster]] = None,
+          keep_training_booster: bool = False,
+          callbacks: Optional[List[Callable]] = None) -> Booster:
+    """reference: engine.py:36."""
+    params = dict(params or {})
+    fobj = None
+    if callable(params.get("objective")):
+        fobj = params["objective"]
+        params["objective"] = "none"
+    # num_boost_round may come via params aliases
+    cfg = Config.from_params(params)
+    if "num_iterations" in params or any(
+            k in params for k in ("num_iteration", "n_iter", "num_tree",
+                                  "num_trees", "num_round", "num_rounds",
+                                  "num_boost_round", "n_estimators")):
+        num_boost_round = cfg.num_iterations
+
+    train_set.params = dict(params, **(train_set.params or {}))
+    train_set.construct()
+
+    booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        init_str = (init_model.model_to_string()
+                    if isinstance(init_model, Booster)
+                    else open(init_model).read())
+        base = Booster(params=params, model_str=init_str)
+        # continued training: preload trees + replay scores
+        booster.inner.models = list(base.inner.models)
+        booster.inner.num_init_iteration = base.inner.current_iteration
+        # text-loaded trees lost their bin-space fields; re-link them to
+        # this training dataset's mappers before binned replay
+        booster.inner.align_trees_to_dataset(booster.inner.train_data)
+        # replay existing model onto the training scores
+        import numpy as _np
+        import jax.numpy as jnp
+        bins = booster.inner.train_data.bins
+        for i, tree in enumerate(booster.inner.models):
+            k = i % booster.inner.num_tree_per_iteration
+            leaf = tree.predict_by_bin(bins, *booster.inner._bin_meta)
+            booster.inner.train_score = \
+                booster.inner.train_score.at[:, k].add(
+                    jnp.asarray(tree.leaf_value[leaf].astype(_np.float32)))
+        booster.inner._has_init_score = True  # don't re-boost from average
+
+    valid_sets = valid_sets or []
+    valid_names = valid_names or []
+    for i, vs in enumerate(valid_sets):
+        if vs is train_set:
+            name = "training"
+            continue  # handled via eval_train
+        name = valid_names[i] if i < len(valid_names) else "valid_%d" % i
+        vs.reference = vs.reference or train_set
+        vs.params = dict(params, **(vs.params or {}))
+        booster.add_valid(vs, name)
+    eval_train_requested = any(vs is train_set for vs in valid_sets)
+
+    callbacks = list(callbacks or [])
+    if cfg.early_stopping_round > 0 and not any(
+            getattr(cb, "order", 0) == 30 for cb in callbacks):
+        callbacks.append(callback_mod.early_stopping(
+            cfg.early_stopping_round,
+            first_metric_only=cfg.first_metric_only,
+            verbose=cfg.verbosity >= 0))
+    callbacks_before = [cb for cb in callbacks
+                        if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks
+                       if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    for i in range(num_boost_round):
+        for cb in callbacks_before:
+            cb(callback_mod.CallbackEnv(
+                model=booster, params=params, iteration=i,
+                begin_iteration=0, end_iteration=num_boost_round,
+                evaluation_result_list=None))
+        finished = booster.update(fobj=fobj)
+        evaluation_result_list = []
+        if valid_sets or eval_train_requested:
+            if eval_train_requested:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in callbacks_after:
+                cb(callback_mod.CallbackEnv(
+                    model=booster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=evaluation_result_list))
+        except callback_mod.EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            for item in (e.best_score or []):
+                booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+            break
+        if finished:
+            break
+    if booster.best_iteration <= 0:
+        booster.best_iteration = booster.current_iteration
+        for item in evaluation_result_list if (valid_sets) else []:
+            booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+    return booster
+
+
+class CVBooster:
+    """Ensemble of per-fold boosters (reference: engine.py:280)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs)
+                    for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, folds, nfold: int, params,
+                  seed: int, stratified: bool, shuffle: bool):
+    """reference: engine.py:432."""
+    full_data.construct()
+    num_data = full_data.num_data()
+    if folds is not None:
+        if not hasattr(folds, "__iter__") and not hasattr(folds, "split"):
+            raise AttributeError(
+                "folds should be a generator or iterator of (train_idx, "
+                "test_idx) tuples or scikit-learn splitter object")
+        if hasattr(folds, "split"):
+            group = full_data.get_group()
+            group_info = None if group is None else np.asarray(group)
+            flatted_group = (np.repeat(np.arange(len(group_info)),
+                                       group_info)
+                             if group_info is not None
+                             else np.zeros(num_data, dtype=np.int64))
+            folds = folds.split(X=np.empty(num_data),
+                                y=full_data.get_label(),
+                                groups=flatted_group)
+        return list(folds)
+    rng = np.random.RandomState(seed)
+    group = full_data.get_group()
+    if group is not None:
+        # group-aware folds: split whole queries
+        group = np.asarray(group, dtype=np.int64)
+        nq = len(group)
+        q_order = rng.permutation(nq) if shuffle else np.arange(nq)
+        q_folds = np.array_split(q_order, nfold)
+        starts = np.concatenate([[0], np.cumsum(group)])
+        out = []
+        for qf in q_folds:
+            test_idx = np.concatenate(
+                [np.arange(starts[q], starts[q + 1]) for q in qf]) \
+                if len(qf) else np.array([], dtype=np.int64)
+            mask = np.ones(num_data, dtype=bool)
+            mask[test_idx] = False
+            out.append((np.where(mask)[0], test_idx))
+        return out
+    if stratified:
+        label = np.asarray(full_data.get_label())
+        idx_per_class = [np.where(label == c)[0]
+                         for c in np.unique(label)]
+        folds_idx = [[] for _ in range(nfold)]
+        for idxs in idx_per_class:
+            if shuffle:
+                rng.shuffle(idxs)
+            for f, chunk in enumerate(np.array_split(idxs, nfold)):
+                folds_idx[f].append(chunk)
+        out = []
+        for f in range(nfold):
+            test_idx = np.concatenate(folds_idx[f])
+            mask = np.ones(num_data, dtype=bool)
+            mask[test_idx] = False
+            out.append((np.where(mask)[0], test_idx))
+        return out
+    order = rng.permutation(num_data) if shuffle else np.arange(num_data)
+    chunks = np.array_split(order, nfold)
+    out = []
+    for test_idx in chunks:
+        mask = np.ones(num_data, dtype=bool)
+        mask[test_idx] = False
+        out.append((np.where(mask)[0], np.sort(test_idx)))
+    return out
+
+
+def _agg_cv_result(raw_results):
+    """reference: engine.py _agg_cv_result — mean/std over folds."""
+    cvmap = collections.OrderedDict()
+    metric_type = {}
+    for one_result in raw_results:
+        for one_line in one_result:
+            key = "%s %s" % (one_line[0], one_line[1])
+            metric_type[key] = one_line[3]
+            cvmap.setdefault(key, [])
+            cvmap[key].append(one_line[2])
+    return [("cv_agg", k, float(np.mean(v)), metric_type[k],
+             float(np.std(v))) for k, v in cvmap.items()]
+
+
+def cv(params: Dict[str, Any], train_set: Dataset,
+       num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True,
+       shuffle: bool = True,
+       metrics: Optional[Union[str, List[str]]] = None,
+       feval=None, init_model=None,
+       callbacks: Optional[List[Callable]] = None,
+       eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, Any]:
+    """reference: engine.py:516."""
+    params = dict(params or {})
+    if metrics is not None:
+        params["metric"] = metrics
+    cfg = Config.from_params(params)
+    if cfg.objective not in ("binary", "multiclass", "multiclassova"):
+        stratified = False
+    train_set.params = dict(params, **(train_set.params or {}))
+    train_set.construct()
+    folds_idx = _make_n_folds(train_set, folds, nfold, params,
+                              cfg.seed, stratified, shuffle)
+    cvbooster = CVBooster()
+    fold_data = []
+    for train_idx, test_idx in folds_idx:
+        tr = train_set.subset(train_idx)
+        va = train_set.subset(test_idx)
+        bst = Booster(params=params, train_set=tr)
+        bst._cv_train = tr
+        bst.add_valid(va, "valid")
+        cvbooster.append(bst)
+        fold_data.append((bst, eval_train_metric))
+
+    callbacks = list(callbacks or [])
+    if cfg.early_stopping_round > 0 and not any(
+            getattr(cb, "order", 0) == 30 for cb in callbacks):
+        callbacks.append(callback_mod.early_stopping(
+            cfg.early_stopping_round,
+            first_metric_only=cfg.first_metric_only,
+            verbose=cfg.verbosity >= 0))
+    callbacks_before = [cb for cb in callbacks
+                        if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks
+                       if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    results = collections.defaultdict(list)
+    for i in range(num_boost_round):
+        for cb in callbacks_before:
+            cb(callback_mod.CallbackEnv(
+                model=cvbooster, params=params, iteration=i,
+                begin_iteration=0, end_iteration=num_boost_round,
+                evaluation_result_list=None))
+        raw = []
+        for bst, with_train in fold_data:
+            bst.update()
+            one = []
+            if with_train:
+                one.extend(bst.eval_train(feval))
+            one.extend(bst.eval_valid(feval))
+            raw.append(one)
+        res = _agg_cv_result(raw)
+        for _, key, mean, _, std in res:
+            results[key + "-mean"].append(mean)
+            results[key + "-stdv"].append(std)
+        try:
+            for cb in callbacks_after:
+                cb(callback_mod.CallbackEnv(
+                    model=cvbooster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=res))
+        except callback_mod.EarlyStopException as e:
+            cvbooster.best_iteration = e.best_iteration + 1
+            for k in list(results.keys()):
+                results[k] = results[k][:cvbooster.best_iteration]
+            break
+    out = dict(results)
+    if return_cvbooster:
+        out["cvbooster"] = cvbooster
+    return out

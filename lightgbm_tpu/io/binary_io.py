@@ -1,0 +1,77 @@
+"""Binned-dataset binary cache.
+
+Equivalent of the reference's Dataset binary serialization
+(reference: Dataset::SaveBinaryFile, include/LightGBM/dataset.h:623;
+DatasetLoader::LoadFromBinFile, src/io/dataset_loader.cpp:417): quantize
+once, reload instantly. The format here is npz + a pickled mapper block
+(our own container — the capability, not the byte layout, is the parity
+target).
+"""
+from __future__ import annotations
+
+import io
+import pickle
+
+import numpy as np
+
+from ..utils import log
+from .dataset import BinnedDataset, Metadata
+
+_MAGIC = "lightgbm_tpu.binned.v1"
+
+
+def save_binary(dataset: BinnedDataset, path: str) -> None:
+    meta = {
+        "magic": _MAGIC,
+        "bin_mappers": dataset.bin_mappers,
+        "used_feature_map": dataset.used_feature_map,
+        "num_total_features": dataset.num_total_features,
+        "feature_names": dataset.feature_names,
+        "max_num_bin": dataset.max_num_bin,
+        "monotone_constraints": dataset.monotone_constraints,
+        "feature_penalty": dataset.feature_penalty,
+    }
+    md = dataset.metadata
+    np.savez_compressed(
+        path, bins=dataset.bins,
+        num_bin_per_feature=dataset.num_bin_per_feature,
+        label=md.label,
+        weights=(md.weights if md.weights is not None
+                 else np.zeros(0, dtype=np.float32)),
+        query_boundaries=(md.query_boundaries
+                          if md.query_boundaries is not None
+                          else np.zeros(0, dtype=np.int32)),
+        init_score=(md.init_score if md.init_score is not None
+                    else np.zeros(0)),
+        meta=np.frombuffer(pickle.dumps(meta), dtype=np.uint8))
+
+
+def load_binary(path: str) -> BinnedDataset:
+    import os
+    if not os.path.exists(path) and os.path.exists(path + ".npz"):
+        path = path + ".npz"  # np.savez appends the suffix
+    z = np.load(path, allow_pickle=False)
+    meta = pickle.loads(z["meta"].tobytes())
+    if meta.get("magic") != _MAGIC:
+        log.fatal("Not a lightgbm_tpu binary dataset: %s" % path)
+    ds = BinnedDataset()
+    ds.bins = z["bins"]
+    ds.num_bin_per_feature = z["num_bin_per_feature"]
+    ds.bin_mappers = meta["bin_mappers"]
+    ds.used_feature_map = meta["used_feature_map"]
+    ds.num_total_features = meta["num_total_features"]
+    ds.feature_names = meta["feature_names"]
+    ds.max_num_bin = meta["max_num_bin"]
+    ds.monotone_constraints = meta["monotone_constraints"]
+    ds.feature_penalty = meta["feature_penalty"]
+    n = ds.bins.shape[0]
+    md = Metadata(n)
+    md.set_label(z["label"])
+    if len(z["weights"]):
+        md.set_weights(z["weights"])
+    if len(z["query_boundaries"]):
+        md.query_boundaries = z["query_boundaries"]
+    if len(z["init_score"]):
+        md.set_init_score(z["init_score"])
+    ds.metadata = md
+    return ds

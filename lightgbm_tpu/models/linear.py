@@ -1,0 +1,115 @@
+"""Piecewise-linear trees: ridge fits in each leaf.
+
+Equivalent of the reference's ``LinearTreeLearner``
+(reference: src/treelearner/linear_tree_learner.cpp:173
+``CalculateLinear``): after the tree structure is grown, each leaf gets a
+linear model over the features used on its path, solved from the
+gradient/hessian normal equations with ``linear_lambda`` ridge
+regularization (config.h:400); leaves with too few rows or singular
+systems keep their constant output. Rows with NaN in any leaf feature fall
+back to the constant (reference: linear prediction NaN handling).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..utils import log
+from .tree import Tree
+
+
+def fit_linear_leaves(tree: Tree, X: np.ndarray, grad: np.ndarray,
+                      hess: np.ndarray, leaf_of_row: np.ndarray,
+                      linear_lambda: float,
+                      row_mask: Optional[np.ndarray] = None,
+                      min_rows: int = 10) -> None:
+    """Fit ``f(x) = const + coef·x_path`` per leaf minimizing
+    sum_i [g_i f + 0.5 h_i f^2] + 0.5*linear_lambda*|coef|^2
+    (the second-order objective the reference solves with Eigen,
+    linear_tree_learner.cpp:290-360)."""
+    X = np.asarray(X, dtype=np.float64)
+    grad = np.asarray(grad, dtype=np.float64)
+    hess = np.asarray(hess, dtype=np.float64)
+    tree.is_linear = True
+    tree.leaf_const = tree.leaf_value.copy()
+    tree.leaf_features = [[] for _ in range(tree.max_leaves)]
+    tree.leaf_coeff = [[] for _ in range(tree.max_leaves)]
+
+    # features on the path to each leaf
+    path_feats = {0: []}
+    for leaf in range(tree.num_leaves):
+        path_feats.setdefault(leaf, [])
+    paths = _leaf_paths(tree)
+
+    for leaf in range(tree.num_leaves):
+        feats = paths.get(leaf, [])
+        if not feats:
+            continue
+        rows = leaf_of_row == leaf
+        if row_mask is not None:
+            rows &= row_mask
+        Xl = X[np.ix_(rows, feats)]
+        ok = ~np.isnan(Xl).any(axis=1)
+        if ok.sum() < max(min_rows, len(feats) + 1):
+            continue
+        Xl = Xl[ok]
+        gl = grad[rows][ok]
+        hl = hess[rows][ok]
+        n, k = Xl.shape
+        A = np.concatenate([Xl, np.ones((n, 1))], axis=1)
+        H = A.T @ (A * hl[:, None])
+        reg = np.eye(k + 1) * linear_lambda
+        reg[-1, -1] = 0.0  # constant not regularized
+        b = -A.T @ gl
+        try:
+            beta = np.linalg.solve(H + reg, b)
+        except np.linalg.LinAlgError:
+            continue
+        if not np.isfinite(beta).all():
+            continue
+        tree.leaf_features[leaf] = [int(f) for f in feats]
+        tree.leaf_coeff[leaf] = [float(v) for v in beta[:-1]]
+        tree.leaf_const[leaf] = float(beta[-1])
+
+
+def _leaf_paths(tree: Tree) -> dict:
+    """Map leaf -> ordered unique feature list on its root path."""
+    out = {}
+    if tree.num_leaves <= 1:
+        return {0: []}
+
+    def walk(node, feats):
+        if node < 0:
+            out[~node] = list(dict.fromkeys(feats))
+            return
+        f = int(tree.split_feature[node])
+        walk(int(tree.left_child[node]), feats + [f])
+        walk(int(tree.right_child[node]), feats + [f])
+
+    walk(0, [])
+    return out
+
+
+def linear_predict(tree: Tree, X: np.ndarray,
+                   leaf_idx: Optional[np.ndarray] = None) -> np.ndarray:
+    """Linear-leaf prediction over raw features (reference:
+    Tree::Predict linear branch, src/io/tree.cpp)."""
+    X = np.asarray(X, dtype=np.float64)
+    if leaf_idx is None:
+        leaf_idx = tree.predict_leaf_index(X)
+    out = tree.leaf_value[leaf_idx].copy()
+    for leaf in range(tree.num_leaves):
+        feats = tree.leaf_features[leaf]
+        if not feats:
+            continue
+        rows = leaf_idx == leaf
+        if not rows.any():
+            continue
+        Xl = X[np.ix_(rows, feats)]
+        ok = ~np.isnan(Xl).any(axis=1)
+        vals = tree.leaf_const[leaf] + Xl @ np.asarray(tree.leaf_coeff[leaf])
+        res = out[rows]
+        res[ok] = vals[ok]
+        out[rows] = res
+    return out

@@ -61,6 +61,20 @@ class Tree:
         self.leaf_parent = np.full(max_leaves, -1, dtype=np.int32)
         self.leaf_depth = np.zeros(max_leaves, dtype=np.int32)
         self.shrinkage = 1.0
+        # categorical splits (reference: tree.h cat_boundaries_/
+        # cat_threshold_ bitsets; num_cat counter)
+        self.num_cat = 0
+        self.cat_boundaries: List[int] = [0]
+        self.cat_threshold: List[int] = []  # uint32 bitset words
+        # session-only: per-node bool mask over BIN ids for fast binned
+        # traversal (not serialized; rebuilt models predict on raw values)
+        self.cat_bin_masks: dict = {}
+        # linear trees (reference: tree.h is_linear_/leaf_const_/
+        # leaf_features_/leaf_coeff_)
+        self.is_linear = False
+        self.leaf_const = np.zeros(0)
+        self.leaf_features: List[List[int]] = []
+        self.leaf_coeff: List[List[float]] = []
 
     # ------------------------------------------------------------------
     def split(self, leaf: int, feature: int, feature_inner: int,
@@ -107,10 +121,60 @@ class Tree:
         return new_leaf
 
     # ------------------------------------------------------------------
+    def split_categorical(self, leaf: int, feature: int, feature_inner: int,
+                          cat_values, bin_mask,
+                          left_value: float, right_value: float,
+                          left_count: int, right_count: int,
+                          left_weight: float, right_weight: float,
+                          gain: float) -> int:
+        """Categorical split: the given category VALUES go left
+        (reference: Tree::SplitCategorical, include/LightGBM/tree.h:85 —
+        bitset words appended to cat_threshold_, node threshold = index
+        into cat_boundaries_)."""
+        node = self.num_leaves - 1
+        new_leaf = self.split(
+            leaf=leaf, feature=feature, feature_inner=feature_inner,
+            threshold_bin=self.num_cat, threshold_real=float(self.num_cat),
+            left_value=left_value, right_value=right_value,
+            left_count=left_count, right_count=right_count,
+            left_weight=left_weight, right_weight=right_weight,
+            gain=gain, missing_type=MissingType.NONE, default_left=False)
+        self.decision_type[node] = kCategoricalMask
+        max_cat = max([int(v) for v in cat_values], default=0)
+        n_words = max_cat // 32 + 1
+        words = [0] * n_words
+        for v in cat_values:
+            v = int(v)
+            if v >= 0:
+                words[v // 32] |= (1 << (v % 32))
+        self.cat_threshold.extend(words)
+        self.cat_boundaries.append(len(self.cat_threshold))
+        self.num_cat += 1
+        self.cat_bin_masks[node] = np.asarray(bin_mask, dtype=bool)
+        return new_leaf
+
+    def _cat_contains(self, cat_idx: int, values: np.ndarray) -> np.ndarray:
+        """Vectorized FindInBitset (reference:
+        include/LightGBM/utils/common.h ``FindInBitset``)."""
+        lo = self.cat_boundaries[cat_idx]
+        hi = self.cat_boundaries[cat_idx + 1]
+        words = np.asarray(self.cat_threshold[lo:hi], dtype=np.uint64)
+        iv = values.astype(np.int64)
+        word_idx = iv // 32
+        ok = (iv >= 0) & (word_idx < len(words))
+        wi = np.clip(word_idx, 0, max(len(words) - 1, 0))
+        bits = (words[wi] >> (iv % 32).astype(np.uint64)) & 1
+        return ok & (bits > 0)
+
+    # ------------------------------------------------------------------
     def apply_shrinkage(self, rate: float) -> None:
         """reference: Tree::Shrinkage (tree.h:113)."""
         self.leaf_value[:self.num_leaves] *= rate
         self.internal_value[:max(self.num_leaves - 1, 0)] *= rate
+        if self.is_linear:
+            self.leaf_const[:self.num_leaves] *= rate
+            self.leaf_coeff = [[c * rate for c in cs]
+                               for cs in self.leaf_coeff]
         self.shrinkage *= rate
 
     def add_bias(self, val: float) -> None:
@@ -123,9 +187,12 @@ class Tree:
 
     # ------------------------------------------------------------------
     def _decide(self, fval: np.ndarray, node: int) -> np.ndarray:
-        """Vectorized NumericalDecision (reference: tree.h:133 Predict →
-        NumericalDecision). True = go left."""
+        """Vectorized Numerical/CategoricalDecision (reference: tree.h:133
+        Predict → NumericalDecision / CategoricalDecision). True = left."""
         dt = int(self.decision_type[node])
+        if dt & kCategoricalMask:
+            iv = np.where(np.isnan(fval), -1.0, fval)
+            return self._cat_contains(int(self.threshold_in_bin[node]), iv)
         missing = (dt >> 2) & 3
         default_left = bool(dt & kDefaultLeftMask)
         thr = self.threshold[node]
@@ -140,7 +207,11 @@ class Tree:
         return go_left
 
     def predict(self, X: np.ndarray) -> np.ndarray:
-        return self.leaf_value[self.predict_leaf_index(X)]
+        leaf = self.predict_leaf_index(X)
+        if self.is_linear:
+            from .linear import linear_predict
+            return linear_predict(self, X, leaf)
+        return self.leaf_value[leaf]
 
     def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
         """Batch traversal; at most num_leaves-1 hops."""
@@ -174,12 +245,19 @@ class Tree:
                 rows = active & (node == nd)
                 f = self.split_feature_inner[nd]
                 b = bins[rows, f]
-                go_left = b <= self.threshold_in_bin[nd]
-                default_left = bool(self.decision_type[nd] & kDefaultLeftMask)
-                if missing_types[f] == MissingType.NAN:
-                    go_left = np.where(b == nan_bins[f], default_left, go_left)
-                elif missing_types[f] == MissingType.ZERO:
-                    go_left = np.where(b == zero_bins[f], default_left, go_left)
+                if self.decision_type[nd] & kCategoricalMask:
+                    mask = self.cat_bin_masks[nd]
+                    go_left = mask[np.minimum(b, len(mask) - 1)]
+                else:
+                    go_left = b <= self.threshold_in_bin[nd]
+                    default_left = bool(self.decision_type[nd]
+                                        & kDefaultLeftMask)
+                    if missing_types[f] == MissingType.NAN:
+                        go_left = np.where(b == nan_bins[f], default_left,
+                                           go_left)
+                    elif missing_types[f] == MissingType.ZERO:
+                        go_left = np.where(b == zero_bins[f], default_left,
+                                           go_left)
                 node[rows] = np.where(go_left, self.left_child[nd],
                                       self.right_child[nd])
             active = node >= 0
@@ -191,7 +269,7 @@ class Tree:
         (src/io/tree.cpp:339-410)."""
         nl = self.num_leaves
         ni = max(nl - 1, 0)
-        lines = [f"num_leaves={nl}", "num_cat=0"]
+        lines = [f"num_leaves={nl}", f"num_cat={self.num_cat}"]
         if nl == 1:
             lines += [f"leaf_value={_fmt(self.leaf_value[0])}"]
         else:
@@ -209,7 +287,28 @@ class Tree:
                 "internal_weight=" + _arr_to_str(self.internal_weight[:ni], True),
                 "internal_count=" + _arr_to_str(self.internal_count[:ni], False),
             ]
-        lines += ["is_linear=0", f"shrinkage={_fmt(self.shrinkage)}", ""]
+            if self.num_cat > 0:
+                lines += [
+                    "cat_boundaries=" + " ".join(
+                        str(v) for v in self.cat_boundaries),
+                    "cat_threshold=" + " ".join(
+                        str(v) for v in self.cat_threshold),
+                ]
+        if self.is_linear:
+            nfeat = [len(self.leaf_features[i]) for i in range(nl)]
+            flat_feats = [f for i in range(nl)
+                          for f in self.leaf_features[i]]
+            flat_coef = [c for i in range(nl) for c in self.leaf_coeff[i]]
+            lines += [
+                "is_linear=1",
+                "leaf_const=" + _arr_to_str(self.leaf_const[:nl], True),
+                "num_features=" + " ".join(str(v) for v in nfeat),
+                "leaf_features=" + " ".join(str(v) for v in flat_feats),
+                "leaf_coeff=" + " ".join(_fmt(v) for v in flat_coef),
+            ]
+        else:
+            lines += ["is_linear=0"]
+        lines += [f"shrinkage={_fmt(self.shrinkage)}", ""]
         return "\n".join(lines)
 
     @classmethod
@@ -252,6 +351,35 @@ class Tree:
             t.internal_weight[:ni] = farr("internal_weight", ni)
         if "internal_count" in kv:
             t.internal_count[:ni] = farr("internal_count", ni, np.int64)
+        if int(kv.get("is_linear", 0)):
+            t.is_linear = True
+            t.leaf_const = np.zeros(max(nl, 1))
+            t.leaf_const[:nl] = farr("leaf_const", nl)
+            nfeat = [int(v) for v in kv.get("num_features", "").split()]
+            flat_feats = [int(v)
+                          for v in kv.get("leaf_features", "").split()]
+            flat_coef = [float(v)
+                         for v in kv.get("leaf_coeff", "").split()]
+            t.leaf_features = []
+            t.leaf_coeff = []
+            pos = 0
+            for c in nfeat:
+                t.leaf_features.append(flat_feats[pos:pos + c])
+                t.leaf_coeff.append(flat_coef[pos:pos + c])
+                pos += c
+            while len(t.leaf_features) < t.max_leaves:
+                t.leaf_features.append([])
+                t.leaf_coeff.append([])
+        t.num_cat = int(kv.get("num_cat", 0))
+        if t.num_cat > 0:
+            t.cat_boundaries = [int(v)
+                                for v in kv["cat_boundaries"].split()]
+            t.cat_threshold = [int(v) for v in kv["cat_threshold"].split()]
+            # categorical nodes store the cat-split index in `threshold`
+            cat_nodes = (t.decision_type[:ni] & kCategoricalMask) != 0
+            t.threshold_in_bin[:ni] = np.where(
+                cat_nodes, t.threshold[:ni].astype(np.int32),
+                t.threshold_in_bin[:ni])
         return t
 
     # ------------------------------------------------------------------

@@ -1,0 +1,87 @@
+"""Objective function factory.
+
+Equivalent of the reference's ``ObjectiveFunction::CreateObjectiveFunction``
+(reference: src/objective/objective_function.cpp:20). ``custom`` returns
+None — gradients are then supplied externally per iteration
+(reference: src/boosting/gbdt.cpp:345-361).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils import log
+from .base import ObjectiveFunction, weighted_percentile
+from .binary import BinaryLogloss
+from .multiclass import MulticlassOVA, MulticlassSoftmax
+from .rank import LambdarankNDCG, RankXENDCG
+from .regression import (RegressionFair, RegressionGamma, RegressionHuber,
+                         RegressionL1, RegressionL2, RegressionMAPE,
+                         RegressionPoisson, RegressionQuantile,
+                         RegressionTweedie)
+from .xentropy import CrossEntropy, CrossEntropyLambda
+
+_OBJECTIVES = {
+    "regression": RegressionL2,
+    "regression_l1": RegressionL1,
+    "huber": RegressionHuber,
+    "fair": RegressionFair,
+    "poisson": RegressionPoisson,
+    "quantile": RegressionQuantile,
+    "mape": RegressionMAPE,
+    "gamma": RegressionGamma,
+    "tweedie": RegressionTweedie,
+    "binary": BinaryLogloss,
+    "multiclass": MulticlassSoftmax,
+    "multiclassova": MulticlassOVA,
+    "cross_entropy": CrossEntropy,
+    "cross_entropy_lambda": CrossEntropyLambda,
+    "lambdarank": LambdarankNDCG,
+    "rank_xendcg": RankXENDCG,
+}
+
+
+def create_objective(name: str, config) -> Optional[ObjectiveFunction]:
+    """Factory (reference: objective_function.cpp:69-104 CPU branch)."""
+    if name in ("custom", "none", "null", "na"):
+        return None
+    if name not in _OBJECTIVES:
+        log.fatal("Unknown objective type name: %s" % name)
+    return _OBJECTIVES[name](config)
+
+
+def load_objective_from_string(s: str, config) -> Optional[ObjectiveFunction]:
+    """Re-create an objective from its model-file line, e.g.
+    ``binary sigmoid:1`` (reference: each objective's string ctor)."""
+    parts = s.strip().split()
+    if not parts:
+        return None
+    name = parts[0]
+    kv = {}
+    for tok in parts[1:]:
+        if ":" in tok:
+            k, v = tok.split(":", 1)
+            kv[k] = v
+        else:
+            kv[tok] = True
+    import dataclasses
+    cfg = config
+    if "sigmoid" in kv:
+        cfg = dataclasses.replace(cfg, sigmoid=float(kv["sigmoid"]))
+    if "num_class" in kv:
+        cfg = dataclasses.replace(cfg, num_class=int(kv["num_class"]))
+    if name not in _OBJECTIVES:
+        return None
+    obj = _OBJECTIVES[name](cfg)
+    if name == "regression" and kv.get("sqrt"):
+        obj.sqrt = True
+    return obj
+
+
+__all__ = [
+    "ObjectiveFunction", "create_objective", "load_objective_from_string",
+    "weighted_percentile", "BinaryLogloss", "MulticlassSoftmax",
+    "MulticlassOVA", "LambdarankNDCG", "RankXENDCG", "RegressionL2",
+    "RegressionL1", "RegressionHuber", "RegressionFair", "RegressionPoisson",
+    "RegressionQuantile", "RegressionMAPE", "RegressionGamma",
+    "RegressionTweedie", "CrossEntropy", "CrossEntropyLambda",
+]

@@ -1,0 +1,143 @@
+"""Objective function interface.
+
+TPU-native equivalent of the reference's ``ObjectiveFunction``
+(reference: include/LightGBM/objective_function.h:19, factory at
+src/objective/objective_function.cpp:20). Where the reference computes
+per-row (grad, hess) into caller-provided CPU buffers with OpenMP (or CUDA
+kernels under device=cuda, src/objective/cuda/), here each objective is a
+pure jitted elementwise function over device-resident scores/labels — the
+natural XLA formulation: one fused kernel per call, no host round-trip.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ObjectiveFunction:
+    """Base objective.
+
+    Lifecycle mirrors the reference: ``init(metadata, num_data)`` binds
+    label/weight device arrays; ``get_gradients(score)`` returns
+    ``(grad, hess)`` device arrays of the same shape as ``score``.
+    """
+
+    #: model-format name (reference: each objective's ToString())
+    name: str = "custom"
+    is_constant_hessian: bool = False
+    need_group: bool = False
+
+    def __init__(self, config):
+        self.config = config
+        self.num_data = 0
+        self.label: Optional[jnp.ndarray] = None
+        self.weights: Optional[jnp.ndarray] = None
+
+    # ------------------------------------------------------------------
+    def init(self, metadata, num_data: int) -> None:
+        """Bind training metadata (reference: ObjectiveFunction::Init)."""
+        self.num_data = num_data
+        self.label = jnp.asarray(
+            np.asarray(metadata.label, dtype=np.float32))
+        if metadata.weights is not None:
+            self.weights = jnp.asarray(
+                np.asarray(metadata.weights, dtype=np.float32))
+        else:
+            self.weights = None
+        self._check_label(np.asarray(metadata.label))
+
+    def _check_label(self, label: np.ndarray) -> None:
+        pass
+
+    # ------------------------------------------------------------------
+    def get_gradients(self, score: jnp.ndarray
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    @property
+    def num_model_per_iteration(self) -> int:
+        """Trees per boosting iteration (reference:
+        ObjectiveFunction::NumModelPerIteration; >1 only for multiclass)."""
+        return 1
+
+    @property
+    def num_tree_per_iteration(self) -> int:
+        return self.num_model_per_iteration
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        """Optimal constant initial score (reference:
+        ObjectiveFunction::BoostFromScore; used when boost_from_average)."""
+        return 0.0
+
+    def class_need_train(self, class_id: int) -> bool:
+        return True
+
+    def convert_output(self, score: np.ndarray) -> np.ndarray:
+        """Raw score -> prediction output (reference:
+        ObjectiveFunction::ConvertOutput; identity except sigmoid/exp/etc.)."""
+        return score
+
+    # ------------------------------------------------------------------
+    def renew_tree_output(self, tree, score: np.ndarray,
+                          leaf_of_row: np.ndarray,
+                          row_mask: Optional[np.ndarray] = None) -> None:
+        """Post-hoc leaf-output renewal (reference:
+        ObjectiveFunction::RenewTreeOutput — percentile-based for
+        l1/quantile/mape; no-op otherwise). ``score`` and ``leaf_of_row``
+        are host arrays over the training rows; ``row_mask`` marks in-bag
+        rows when bagging."""
+        return None
+
+    @property
+    def is_renew_tree_output(self) -> bool:
+        return False
+
+    def to_string(self) -> str:
+        return self.name
+
+    def __str__(self) -> str:  # model file "objective=..." line
+        return self.to_string()
+
+
+def weighted_percentile(values: np.ndarray, weights: Optional[np.ndarray],
+                        alpha: float) -> float:
+    """Percentile matching the reference's ``PercentileFun`` /
+    ``WeightedPercentileFun`` exactly, including interpolation quirks
+    (src/objective/regression_objective.hpp:19-88). ``alpha`` has the
+    reference call-site meaning: 0.5 for the L1/MAPE median, the
+    objective's alpha for quantile."""
+    n = len(values)
+    if n == 0:
+        return 0.0
+    if n == 1:
+        return float(values[0])
+    if weights is None:
+        # PercentileFun: position (1-alpha)*n in DESCENDING order
+        float_pos = (1.0 - alpha) * n
+        pos = int(float_pos)
+        if pos < 1:
+            return float(values.max())
+        if pos >= n:
+            return float(values.min())
+        bias = float_pos - pos
+        desc = np.sort(values)[::-1]
+        v1, v2 = float(desc[pos - 1]), float(desc[pos])
+        return v1 - (v1 - v2) * bias
+    # WeightedPercentileFun: ascending weighted CDF, threshold total*alpha
+    order = np.argsort(values, kind="stable")
+    sv = values[order]
+    cdf = np.cumsum(weights[order])
+    threshold = cdf[-1] * alpha
+    pos = int(np.searchsorted(cdf, threshold, side="right"))
+    pos = min(pos, n - 1)
+    if pos == 0 or pos == n - 1:
+        return float(sv[pos])
+    v1, v2 = float(sv[pos - 1]), float(sv[pos])
+    if pos + 1 < n and cdf[pos + 1] - cdf[pos] >= 1.0:
+        return ((threshold - cdf[pos]) / (cdf[pos + 1] - cdf[pos])
+                * (v2 - v1) + v1)
+    return v2

@@ -1,0 +1,115 @@
+"""Binary classification objective.
+
+TPU-native equivalent of the reference's ``BinaryLogloss``
+(reference: src/objective/binary_objective.hpp:21; CUDA mirror
+src/objective/cuda/cuda_binary_objective.cpp).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import log
+from .base import ObjectiveFunction
+
+_EPS = 1e-12
+
+
+class BinaryLogloss(ObjectiveFunction):
+    """Sigmoid-scaled logloss (reference: binary_objective.hpp:105-135):
+
+        response = -label * sigmoid / (1 + exp(label * sigmoid * score))
+        grad = response * label_weight
+        hess = |response| * (sigmoid - |response|) * label_weight
+
+    with label in {-1, +1}, label weights from is_unbalance /
+    scale_pos_weight (Init, :59-102)."""
+
+    name = "binary"
+
+    def __init__(self, config, is_pos=None):
+        super().__init__(config)
+        self.sigmoid = float(config.sigmoid)
+        if self.sigmoid <= 0.0:
+            log.fatal("Sigmoid parameter %f should be greater than zero"
+                      % self.sigmoid)
+        self.is_unbalance = bool(config.is_unbalance)
+        self.scale_pos_weight = float(config.scale_pos_weight)
+        if self.is_unbalance and abs(self.scale_pos_weight - 1.0) > 1e-6:
+            log.fatal("Cannot set is_unbalance and scale_pos_weight at the "
+                      "same time")
+        self._is_pos = is_pos if is_pos is not None else (lambda y: y > 0)
+        self.need_train = True
+        self.num_pos_data = 0
+
+    def init(self, metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        raw = np.asarray(metadata.label)
+        is_pos = self._is_pos(raw)
+        cnt_positive = int(is_pos.sum())
+        cnt_negative = num_data - cnt_positive
+        self.num_pos_data = cnt_positive
+        self.need_train = True
+        if cnt_negative == 0 or cnt_positive == 0:
+            log.warning("Contains only one class")
+            self.need_train = False
+        log.info("Number of positive: %d, number of negative: %d"
+                 % (cnt_positive, cnt_negative))
+        pos_weight, neg_weight = 1.0, 1.0
+        if self.is_unbalance and cnt_positive > 0 and cnt_negative > 0:
+            if cnt_positive > cnt_negative:
+                neg_weight = cnt_positive / cnt_negative
+            else:
+                pos_weight = cnt_negative / cnt_positive
+        pos_weight *= self.scale_pos_weight
+        # precompute per-row signed label (+-1) and label weight
+        self.label_sign = jnp.asarray(
+            np.where(is_pos, 1.0, -1.0).astype(np.float32))
+        self.label_weight = jnp.asarray(
+            np.where(is_pos, pos_weight, neg_weight).astype(np.float32))
+        self._is_pos_np = is_pos
+
+    @partial(jax.jit, static_argnums=0)
+    def _grads(self, score, label_sign, label_weight, weights):
+        response = (-label_sign * self.sigmoid
+                    / (1.0 + jnp.exp(label_sign * self.sigmoid * score)))
+        abs_r = jnp.abs(response)
+        grad = response * label_weight
+        hess = abs_r * (self.sigmoid - abs_r) * label_weight
+        if weights is not None:
+            grad = grad * weights
+            hess = hess * weights
+        return grad, hess
+
+    def get_gradients(self, score):
+        if not self.need_train:
+            z = jnp.zeros_like(score)
+            return z, z
+        return self._grads(score, self.label_sign, self.label_weight,
+                           self.weights)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        is_pos = self._is_pos_np.astype(np.float64)
+        if self.weights is not None:
+            w = np.asarray(self.weights, dtype=np.float64)
+            pavg = (is_pos * w).sum() / w.sum()
+        else:
+            pavg = is_pos.mean()
+        pavg = min(max(pavg, _EPS), 1.0 - _EPS)
+        initscore = float(np.log(pavg / (1.0 - pavg)) / self.sigmoid)
+        log.info("[%s:BoostFromScore]: pavg=%f -> initscore=%f"
+                 % (self.name, pavg, initscore))
+        return initscore
+
+    def class_need_train(self, class_id: int) -> bool:
+        return self.need_train
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + np.exp(-self.sigmoid * score))
+
+    def to_string(self) -> str:
+        return "%s sigmoid:%g" % (self.name, self.sigmoid)

@@ -1,0 +1,125 @@
+"""Multiclass objectives: softmax and one-vs-all.
+
+TPU-native equivalents of the reference's MulticlassSoftmax /
+MulticlassOVA (reference: src/objective/multiclass_objective.hpp:22,176).
+Scores and gradients are [N, K] device arrays; the softmax gradient is a
+single fused XLA kernel over the class axis (the reference loops classes
+with a rescaling factor K/(K-1), :31,101-105).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import log
+from .base import ObjectiveFunction
+from .binary import BinaryLogloss
+
+
+class MulticlassSoftmax(ObjectiveFunction):
+    """Softmax objective (reference: multiclass_objective.hpp:22):
+    p = softmax(score_row); grad_k = p_k - 1{y=k};
+    hess_k = factor * p_k * (1 - p_k), factor = K/(K-1)."""
+
+    name = "multiclass"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+        if self.num_class < 2:
+            log.fatal("num_class should be >= 2 for multiclass")
+        self.factor = self.num_class / (self.num_class - 1.0)
+
+    @property
+    def num_model_per_iteration(self) -> int:
+        return self.num_class
+
+    def _check_label(self, label: np.ndarray) -> None:
+        li = label.astype(np.int32)
+        if not np.allclose(li, label):
+            log.fatal("Label must be int type for multiclass")
+        if li.min() < 0 or li.max() >= self.num_class:
+            log.fatal("Label must be in [0, %d) for multiclass, but found "
+                      "%d" % (self.num_class, int(li.max())))
+
+    def init(self, metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        li = np.asarray(metadata.label).astype(np.int32)
+        self.label_onehot = jnp.asarray(
+            np.eye(self.num_class, dtype=np.float32)[li])
+
+    @partial(jax.jit, static_argnums=0)
+    def _grads(self, score, label_onehot, weights):
+        p = jax.nn.softmax(score, axis=1)
+        grad = p - label_onehot
+        hess = self.factor * p * (1.0 - p)
+        if weights is not None:
+            grad = grad * weights[:, None]
+            hess = hess * weights[:, None]
+        return grad, hess
+
+    def get_gradients(self, score):
+        return self._grads(score, self.label_onehot, self.weights)
+
+    def convert_output(self, score):
+        e = np.exp(score - score.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+
+    def to_string(self) -> str:
+        return "%s num_class:%d" % (self.name, self.num_class)
+
+
+class MulticlassOVA(ObjectiveFunction):
+    """One-vs-all (reference: MulticlassOVA,
+    multiclass_objective.hpp:176): K independent BinaryLogloss objectives,
+    one per class column."""
+
+    name = "multiclassova"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+        if self.num_class < 2:
+            log.fatal("num_class should be >= 2 for multiclassova")
+        self.sigmoid = float(config.sigmoid)
+        self._binary: List[BinaryLogloss] = [
+            BinaryLogloss(config, is_pos=_make_is_pos(k))
+            for k in range(self.num_class)]
+
+    @property
+    def num_model_per_iteration(self) -> int:
+        return self.num_class
+
+    def init(self, metadata, num_data: int) -> None:
+        self.num_data = num_data
+        for b in self._binary:
+            b.init(metadata, num_data)
+
+    def get_gradients(self, score):
+        grads, hesss = [], []
+        for k, b in enumerate(self._binary):
+            g, h = b.get_gradients(score[:, k])
+            grads.append(g)
+            hesss.append(h)
+        return jnp.stack(grads, axis=1), jnp.stack(hesss, axis=1)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return self._binary[class_id].boost_from_score(0)
+
+    def class_need_train(self, class_id: int) -> bool:
+        return self._binary[class_id].class_need_train(0)
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + np.exp(-self.sigmoid * score))
+
+    def to_string(self) -> str:
+        return "%s num_class:%d sigmoid:%g" % (
+            self.name, self.num_class, self.sigmoid)
+
+
+def _make_is_pos(k: int):
+    return lambda y: np.asarray(y).astype(np.int32) == k

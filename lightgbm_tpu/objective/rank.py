@@ -1,0 +1,272 @@
+"""Learning-to-rank objectives: LambdarankNDCG and RankXENDCG.
+
+TPU-native equivalents of the reference's ranking family
+(reference: src/objective/rank_objective.hpp:25 RankingObjective,
+:96 LambdarankNDCG, :285 RankXENDCG). The reference parallelizes with one
+OpenMP task per query over ragged [start, end) ranges; ragged loops don't
+jit, so here queries are padded to a common length L and processed as a
+[Q, L] batch: a vmapped pairwise [L, L] lambda computation, chunked with
+``lax.map`` so peak memory is chunk*L^2 — the lambda matrix never hits HBM
+whole. Pair weighting, truncation, sigmoid and normalization follow the
+reference exactly (rank_objective.hpp:146-227); the sigmoid lookup table
+(:230-256, a CPU trick to avoid exp) is pointless on TPU — the VPU computes
+exp directly.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import log
+from . import dcg
+from .base import ObjectiveFunction
+
+_QUERY_CHUNK = 64
+
+
+class QueryLayout:
+    """Padded [Q, L] view of ragged per-query rows.
+
+    ``doc_idx[q, j]`` indexes into the flat row space; padding slots point
+    at row N (one past the end) so gathers read a zero pad row and
+    scatters accumulate into a discarded slot.
+    """
+
+    def __init__(self, query_boundaries: np.ndarray, num_data: int):
+        qb = np.asarray(query_boundaries, dtype=np.int64)
+        self.num_queries = len(qb) - 1
+        self.counts = (qb[1:] - qb[:-1]).astype(np.int32)
+        self.max_len = int(self.counts.max()) if self.num_queries else 0
+        Q, L = self.num_queries, self.max_len
+        doc_idx = np.full((Q, L), num_data, dtype=np.int32)
+        for q in range(Q):
+            c = self.counts[q]
+            doc_idx[q, :c] = np.arange(qb[q], qb[q + 1], dtype=np.int32)
+        self.doc_idx = jnp.asarray(doc_idx)
+        self.mask = jnp.asarray(
+            np.arange(L, dtype=np.int32)[None, :] < self.counts[:, None])
+        self.num_data = num_data
+
+
+def _pad_queries(layout: QueryLayout, chunk: int):
+    """Round Q up to a chunk multiple; padding queries have empty masks."""
+    Q, L = layout.doc_idx.shape
+    Qp = -(-Q // chunk) * chunk
+    if Qp == Q:
+        return layout.doc_idx, layout.mask, Qp
+    pad_idx = jnp.full((Qp - Q, L), layout.num_data, dtype=jnp.int32)
+    pad_mask = jnp.zeros((Qp - Q, L), dtype=bool)
+    return (jnp.concatenate([layout.doc_idx, pad_idx]),
+            jnp.concatenate([layout.mask, pad_mask]), Qp)
+
+
+class LambdarankNDCG(ObjectiveFunction):
+    """reference: rank_objective.hpp:96. Per query, for each doc pair with
+    different labels where the better-ranked doc is above
+    ``lambdarank_truncation_level``:
+
+        delta_ndcg = |gain_hi - gain_lo| * |disc(rank_hi) - disc(rank_lo)|
+                     * inv_max_dcg            (normed by 0.01+|ds| if norm)
+        p = 1 / (1 + exp(sigmoid * (s_hi - s_lo)))
+        lambda_hi -= sigmoid * delta_ndcg * p   (lambda_lo gets +)
+        hess_both += sigmoid^2 * delta_ndcg * p * (1 - p)
+
+    then the query's lambdas are rescaled by log2(1+S)/S where
+    S = sum of 2*sigmoid*delta_ndcg*p (the reference's sum_lambdas)."""
+
+    name = "lambdarank"
+    need_group = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sigmoid = float(config.sigmoid)
+        if self.sigmoid <= 0.0:
+            log.fatal("Sigmoid param %f should be greater than zero"
+                      % self.sigmoid)
+        self.norm = bool(config.lambdarank_norm)
+        self.truncation_level = int(config.lambdarank_truncation_level)
+        self.label_gain = dcg.resolve_label_gain(config.label_gain)
+
+    def init(self, metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            log.fatal("Ranking tasks require query information")
+        label_np = np.asarray(metadata.label)
+        dcg.check_label(label_np, len(self.label_gain))
+        self.layout = QueryLayout(metadata.query_boundaries, num_data)
+        qb = np.asarray(metadata.query_boundaries)
+        inv = np.zeros(self.layout.num_queries, dtype=np.float64)
+        for q in range(self.layout.num_queries):
+            m = dcg.max_dcg_at_k(self.truncation_level,
+                                 label_np[qb[q]:qb[q + 1]], self.label_gain)
+            inv[q] = 1.0 / m if m > 0.0 else 0.0
+        self.inverse_max_dcgs = jnp.asarray(inv.astype(np.float32))
+        self.gain_table = jnp.asarray(self.label_gain.astype(np.float32))
+        L = self.layout.max_len
+        self.discount_table = jnp.asarray(
+            dcg.discounts(max(L, 1)).astype(np.float32))
+
+    # ------------------------------------------------------------------
+    def _query_lambdas(self, labels, scores, mask, inv_max_dcg):
+        """One query's lambdas/hessians over padded [L] arrays."""
+        L = labels.shape[0]
+        neg_inf = jnp.float32(-1e30)
+        s = jnp.where(mask, scores, neg_inf)
+        # rank of each doc in descending-score order
+        order = jnp.argsort(-s, stable=True)
+        rank = jnp.argsort(order, stable=True).astype(jnp.int32)  # [L]
+        discount = self.discount_table[jnp.clip(rank, 0, L - 1)]
+        gain = self.gain_table[jnp.clip(labels.astype(jnp.int32), 0,
+                                        self.gain_table.shape[0] - 1)]
+        best_score = jnp.max(s)
+        # worst valid score (reference skips kMinScore docs)
+        worst_score = jnp.min(jnp.where(mask, scores, jnp.inf))
+
+        lab = labels.astype(jnp.float32)
+        # a = high candidate, b = low candidate; pair counted once with
+        # label[a] > label[b]
+        is_pair = (lab[:, None] > lab[None, :]) & mask[:, None] & mask[None, :]
+        in_trunc = jnp.minimum(rank[:, None], rank[None, :]) \
+            < self.truncation_level
+        is_pair &= in_trunc
+
+        delta_score = s[:, None] - s[None, :]
+        dcg_gap = gain[:, None] - gain[None, :]
+        paired_discount = jnp.abs(discount[:, None] - discount[None, :])
+        delta_ndcg = dcg_gap * paired_discount * inv_max_dcg
+        if self.norm:
+            delta_ndcg = jnp.where(
+                best_score != worst_score,
+                delta_ndcg / (0.01 + jnp.abs(delta_score)), delta_ndcg)
+        p = 1.0 / (1.0 + jnp.exp(
+            jnp.clip(self.sigmoid * delta_score, -50.0, 50.0)))
+        lam = jnp.where(is_pair, self.sigmoid * delta_ndcg * p, 0.0)
+        hes = jnp.where(is_pair,
+                        self.sigmoid * self.sigmoid * delta_ndcg
+                        * p * (1.0 - p), 0.0)
+        # The high doc's gradient decreases (descent pushes its score up):
+        # reference does lambdas[high] += p_lambda with p_lambda < 0
+        # (rank_objective.hpp:210-215). Rows of ``lam`` are the high role.
+        lambdas = jnp.sum(lam, axis=0) - jnp.sum(lam, axis=1)
+        hessians = jnp.sum(hes, axis=1) + jnp.sum(hes, axis=0)
+        sum_lambdas = 2.0 * jnp.sum(lam)
+        if self.norm:
+            norm_factor = jnp.where(
+                sum_lambdas > 0,
+                jnp.log2(1.0 + sum_lambdas) / jnp.maximum(sum_lambdas, 1e-30),
+                1.0)
+            lambdas = lambdas * norm_factor
+            hessians = hessians * norm_factor
+        return lambdas, hessians
+
+    @partial(jax.jit, static_argnums=0)
+    def _grads(self, score, labels_pad, doc_idx, mask, inv_max_dcgs, weights):
+        N = score.shape[0]
+        score_pad = jnp.concatenate([score, jnp.zeros((1,), score.dtype)])
+        scores_p = score_pad[doc_idx]                       # [Qp, L]
+
+        Qp, L = doc_idx.shape
+        nchunk = Qp // _QUERY_CHUNK
+
+        def one_chunk(args):
+            lb, sc, mk, inv = args
+            return jax.vmap(self._query_lambdas)(lb, sc, mk, inv)
+
+        resh = lambda a: a.reshape((nchunk, _QUERY_CHUNK) + a.shape[1:])
+        lam, hes = jax.lax.map(one_chunk, (
+            resh(labels_pad), resh(scores_p), resh(mask), resh(inv_max_dcgs)))
+        lam = lam.reshape(Qp * L)
+        hes = hes.reshape(Qp * L)
+        flat_idx = doc_idx.reshape(-1)
+        grad = jnp.zeros(N + 1, dtype=jnp.float32).at[flat_idx].add(lam)[:N]
+        hess = jnp.zeros(N + 1, dtype=jnp.float32).at[flat_idx].add(hes)[:N]
+        if weights is not None:
+            grad = grad * weights
+            hess = hess * weights
+        return grad, hess
+
+    def get_gradients(self, score):
+        lay = self.layout
+        doc_idx, mask, Qp = _pad_queries(lay, _QUERY_CHUNK)
+        if not hasattr(self, "_labels_pad"):
+            label_pad = jnp.concatenate(
+                [self.label, jnp.zeros((1,), self.label.dtype)])
+            self._labels_pad = label_pad[doc_idx]
+            inv = self.inverse_max_dcgs
+            self._inv_pad = jnp.concatenate(
+                [inv, jnp.zeros(Qp - lay.num_queries, inv.dtype)])
+            self._doc_idx_pad, self._mask_pad = doc_idx, mask
+        return self._grads(score, self._labels_pad, self._doc_idx_pad,
+                           self._mask_pad, self._inv_pad, self.weights)
+
+
+class RankXENDCG(ObjectiveFunction):
+    """XE_NDCG (reference: rank_objective.hpp:285; arXiv:1911.09798):
+    per query, rho = softmax(scores); targets phi_i = 2^label_i - u_i with
+    u ~ U[0,1) resampled every call; three-term gradient expansion and
+    hess = rho(1-rho)."""
+
+    name = "rank_xendcg"
+    need_group = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.seed = int(config.objective_seed)
+        self._key = jax.random.PRNGKey(self.seed)
+
+    def init(self, metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            log.fatal("Ranking tasks require query information")
+        self.layout = QueryLayout(metadata.query_boundaries, num_data)
+
+    def _query_grads(self, labels, scores, mask, unif):
+        neg_inf = jnp.float32(-1e30)
+        s = jnp.where(mask, scores, neg_inf)
+        rho = jax.nn.softmax(s)
+        rho = jnp.where(mask, rho, 0.0)
+        cnt = jnp.sum(mask)
+        phi = jnp.where(mask, 2.0 ** labels - unif, 0.0)
+        inv_denominator = 1.0 / jnp.maximum(jnp.sum(phi), 1e-12)
+        l1 = -phi * inv_denominator + rho
+        params1 = jnp.where(mask, l1 / jnp.maximum(1.0 - rho, 1e-12), 0.0)
+        sum_l1 = jnp.sum(params1)
+        l2 = rho * (sum_l1 - params1)
+        params2 = jnp.where(mask, l2 / jnp.maximum(1.0 - rho, 1e-12), 0.0)
+        sum_l2 = jnp.sum(params2)
+        lambdas = l1 + l2 + rho * (sum_l2 - params2)
+        hessians = rho * (1.0 - rho)
+        ok = mask & (cnt > 1)
+        return jnp.where(ok, lambdas, 0.0), jnp.where(ok, hessians, 0.0)
+
+    @partial(jax.jit, static_argnums=0)
+    def _grads(self, score, labels_pad, doc_idx, mask, key, weights):
+        N = score.shape[0]
+        score_pad = jnp.concatenate([score, jnp.zeros((1,), score.dtype)])
+        scores_p = score_pad[doc_idx]
+        unif = jax.random.uniform(key, doc_idx.shape)
+        lam, hes = jax.vmap(self._query_grads)(
+            labels_pad, scores_p, mask, unif)
+        flat_idx = doc_idx.reshape(-1)
+        grad = jnp.zeros(N + 1, dtype=jnp.float32) \
+            .at[flat_idx].add(lam.reshape(-1))[:N]
+        hess = jnp.zeros(N + 1, dtype=jnp.float32) \
+            .at[flat_idx].add(hes.reshape(-1))[:N]
+        if weights is not None:
+            grad = grad * weights
+            hess = hess * weights
+        return grad, hess
+
+    def get_gradients(self, score):
+        lay = self.layout
+        if not hasattr(self, "_labels_pad"):
+            label_pad = jnp.concatenate(
+                [self.label, jnp.zeros((1,), self.label.dtype)])
+            self._labels_pad = label_pad[lay.doc_idx]
+        self._key, sub = jax.random.split(self._key)
+        return self._grads(score, self._labels_pad, lay.doc_idx, lay.mask,
+                           sub, self.weights)

@@ -1,0 +1,353 @@
+"""Regression objectives.
+
+TPU-native equivalents of the reference's regression family
+(reference: src/objective/regression_objective.hpp; CUDA mirrors under
+src/objective/cuda/). Each objective's (grad, hess) is a pure jitted
+elementwise function over device arrays — XLA fuses it into one kernel,
+the analogue of the reference's CUDA objective kernels writing into
+device-resident gradient buffers.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import log
+from .base import ObjectiveFunction, weighted_percentile
+
+
+def _apply_weight(grad, hess, weights):
+    if weights is None:
+        return grad, hess
+    return grad * weights, hess * weights
+
+
+class RegressionL2(ObjectiveFunction):
+    """L2 loss (reference: RegressionL2loss,
+    src/objective/regression_objective.hpp:127-139: grad = score - label,
+    hess = 1; optional sqrt label transform)."""
+
+    name = "regression"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sqrt = bool(config.reg_sqrt)
+        self._raw_label: Optional[np.ndarray] = None
+
+    @property
+    def is_constant_hessian(self) -> bool:
+        return self.weights is None
+
+    def init(self, metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        if self.sqrt:
+            raw = np.asarray(metadata.label, dtype=np.float64)
+            self._raw_label = raw
+            trans = np.sign(raw) * np.sqrt(np.abs(raw))
+            self.label = jnp.asarray(trans.astype(np.float32))
+
+    @partial(jax.jit, static_argnums=0)
+    def _grads(self, score, label, weights):
+        grad = score - label
+        hess = jnp.ones_like(score)
+        return _apply_weight(grad, hess, weights)
+
+    def get_gradients(self, score):
+        return self._grads(score, self.label, self.weights)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        label = np.asarray(self.label, dtype=np.float64)
+        if self.weights is not None:
+            w = np.asarray(self.weights, dtype=np.float64)
+            return float((label * w).sum() / w.sum())
+        return float(label.mean())
+
+    def convert_output(self, score):
+        if self.sqrt:
+            return np.sign(score) * score * score
+        return score
+
+    def to_string(self) -> str:
+        return self.name + (" sqrt" if self.sqrt else "")
+
+
+class RegressionL1(RegressionL2):
+    """L1 loss (reference: RegressionL1loss,
+    src/objective/regression_objective.hpp:217-236): grad = sign(diff),
+    hess = 1; leaf outputs renewed to the weighted median of residuals
+    (RenewTreeOutput at :253)."""
+
+    name = "regression_l1"
+    _renew_alpha = 0.5
+
+    @property
+    def is_constant_hessian(self) -> bool:
+        return self.weights is None
+
+    @partial(jax.jit, static_argnums=0)
+    def _grads(self, score, label, weights):
+        grad = jnp.sign(score - label)
+        hess = jnp.ones_like(score)
+        return _apply_weight(grad, hess, weights)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        label = np.asarray(self.label, dtype=np.float64)
+        w = (None if self.weights is None
+             else np.asarray(self.weights, dtype=np.float64))
+        return weighted_percentile(label, w, self._renew_alpha)
+
+    @property
+    def is_renew_tree_output(self) -> bool:
+        return True
+
+    def _renew_weights(self) -> Optional[np.ndarray]:
+        return (None if self.weights is None
+                else np.asarray(self.weights, dtype=np.float64))
+
+    def renew_tree_output(self, tree, score, leaf_of_row, row_mask=None):
+        label = np.asarray(self.label, dtype=np.float64)
+        residual = label - score
+        w = self._renew_weights()
+        for leaf in range(tree.num_leaves):
+            rows = leaf_of_row == leaf
+            if row_mask is not None:
+                rows &= row_mask
+            if not rows.any():
+                continue
+            out = weighted_percentile(
+                residual[rows], None if w is None else w[rows],
+                self._renew_alpha)
+            tree.set_leaf_output(leaf, out)
+
+
+class RegressionHuber(RegressionL2):
+    """Huber loss (reference: RegressionHuberLoss,
+    src/objective/regression_objective.hpp:292+): grad = diff clipped to
+    +-alpha, hess = 1; sqrt transform disabled."""
+
+    name = "huber"
+
+    def __init__(self, config):
+        super().__init__(config)
+        if self.sqrt:
+            log.warning("Cannot use sqrt transform in %s Regression, "
+                        "will auto disable it", self.name)
+            self.sqrt = False
+        self.alpha = float(config.alpha)
+        if self.alpha <= 0.0:
+            log.fatal("alpha should be greater than 0")
+
+    @partial(jax.jit, static_argnums=0)
+    def _grads(self, score, label, weights):
+        diff = score - label
+        grad = jnp.clip(diff, -self.alpha, self.alpha)
+        hess = jnp.ones_like(score)
+        return _apply_weight(grad, hess, weights)
+
+
+class RegressionFair(RegressionL2):
+    """Fair loss (reference: RegressionFairLoss,
+    src/objective/regression_objective.hpp:352+): grad = c*x/(|x|+c),
+    hess = c^2/(|x|+c)^2."""
+
+    name = "fair"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sqrt = False
+        self.c = float(config.fair_c)
+
+    @property
+    def is_constant_hessian(self) -> bool:
+        return False
+
+    @partial(jax.jit, static_argnums=0)
+    def _grads(self, score, label, weights):
+        x = score - label
+        denom = jnp.abs(x) + self.c
+        grad = self.c * x / denom
+        hess = self.c * self.c / (denom * denom)
+        return _apply_weight(grad, hess, weights)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return 0.0
+
+
+class RegressionPoisson(RegressionL2):
+    """Poisson regression (reference: RegressionPoissonLoss,
+    src/objective/regression_objective.hpp:407+): scores are log-scale;
+    grad = exp(s) - label, hess = exp(s + poisson_max_delta_step);
+    BoostFromScore = log(weighted mean label)."""
+
+    name = "poisson"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sqrt = False
+        self.max_delta_step = float(config.poisson_max_delta_step)
+        if self.max_delta_step <= 0.0:
+            log.fatal("poisson_max_delta_step should be greater than 0")
+
+    def _check_label(self, label: np.ndarray) -> None:
+        if (label < 0).any():
+            log.fatal("[%s]: at least one target label is negative" % self.name)
+        if label.sum() <= 0.0:
+            log.fatal("[%s]: sum of labels is zero" % self.name)
+
+    @property
+    def is_constant_hessian(self) -> bool:
+        return False
+
+    @partial(jax.jit, static_argnums=0)
+    def _grads(self, score, label, weights):
+        exp_score = jnp.exp(score)
+        grad = exp_score - label
+        hess = exp_score * np.exp(self.max_delta_step)
+        return _apply_weight(grad, hess, weights)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        mean = super().boost_from_score(class_id)
+        return float(np.log(max(mean, 1e-20)))
+
+    def convert_output(self, score):
+        return np.exp(score)
+
+
+class RegressionQuantile(RegressionL2):
+    """Quantile regression (reference: RegressionQuantileloss,
+    src/objective/regression_objective.hpp:478+): grad = (1-alpha) if
+    score > label else -alpha, hess = 1; leaf renewal at the alpha
+    percentile of residuals."""
+
+    name = "quantile"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sqrt = False
+        self.alpha = float(config.alpha)
+        if not (0.0 < self.alpha < 1.0):
+            log.fatal("alpha should be in (0, 1) for quantile objective")
+
+    @property
+    def is_constant_hessian(self) -> bool:
+        return self.weights is None
+
+    @partial(jax.jit, static_argnums=0)
+    def _grads(self, score, label, weights):
+        grad = jnp.where(score > label, 1.0 - self.alpha, -self.alpha)
+        hess = jnp.ones_like(score)
+        return _apply_weight(grad, hess, weights)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        label = np.asarray(self.label, dtype=np.float64)
+        w = (None if self.weights is None
+             else np.asarray(self.weights, dtype=np.float64))
+        return weighted_percentile(label, w, self.alpha)
+
+    @property
+    def is_renew_tree_output(self) -> bool:
+        return True
+
+    def renew_tree_output(self, tree, score, leaf_of_row, row_mask=None):
+        label = np.asarray(self.label, dtype=np.float64)
+        residual = label - score
+        w = (None if self.weights is None
+             else np.asarray(self.weights, dtype=np.float64))
+        for leaf in range(tree.num_leaves):
+            rows = leaf_of_row == leaf
+            if row_mask is not None:
+                rows &= row_mask
+            if not rows.any():
+                continue
+            out = weighted_percentile(
+                residual[rows], None if w is None else w[rows],
+                self.alpha)
+            tree.set_leaf_output(leaf, out)
+
+
+class RegressionMAPE(RegressionL1):
+    """MAPE (reference: RegressionMAPELOSS,
+    src/objective/regression_objective.hpp:579+): per-row label weight
+    1/max(1,|label|); grad = sign(diff)*label_weight, hess = label_weight
+    (or user weight); renewal weighted by label_weight."""
+
+    name = "mape"
+
+    def init(self, metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        raw = np.asarray(metadata.label, dtype=np.float64)
+        lw = 1.0 / np.maximum(1.0, np.abs(raw))
+        if metadata.weights is not None:
+            lw = lw * np.asarray(metadata.weights, dtype=np.float64)
+        if (np.abs(raw) < 1).any():
+            log.warning(
+                "Some label values are < 1 in absolute value. MAPE is "
+                "unstable with such values, so LightGBM rounds them to "
+                "1.0 when computing MAPE.")
+        self.label_weight = jnp.asarray(lw.astype(np.float32))
+        self._label_weight_np = lw
+
+    @property
+    def is_constant_hessian(self) -> bool:
+        return self.weights is None
+
+    def get_gradients(self, score):
+        return self._grads_mape(score, self.label, self.label_weight,
+                                self.weights)
+
+    @partial(jax.jit, static_argnums=0)
+    def _grads_mape(self, score, label, label_weight, weights):
+        grad = jnp.sign(score - label) * label_weight
+        hess = (jnp.ones_like(score) if weights is None else weights)
+        return grad, hess
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        label = np.asarray(self.label, dtype=np.float64)
+        return weighted_percentile(label, self._label_weight_np, 0.5)
+
+    def _renew_weights(self) -> Optional[np.ndarray]:
+        return self._label_weight_np
+
+
+class RegressionGamma(RegressionPoisson):
+    """Gamma regression (reference: RegressionGammaLoss,
+    src/objective/regression_objective.hpp:679+): grad = 1 - label*exp(-s),
+    hess = label*exp(-s)."""
+
+    name = "gamma"
+
+    @partial(jax.jit, static_argnums=0)
+    def _grads(self, score, label, weights):
+        exp_ns = jnp.exp(-score)
+        grad = 1.0 - label * exp_ns
+        hess = label * exp_ns
+        return _apply_weight(grad, hess, weights)
+
+
+class RegressionTweedie(RegressionPoisson):
+    """Tweedie regression (reference: RegressionTweedieLoss,
+    src/objective/regression_objective.hpp:716+): with rho =
+    tweedie_variance_power, grad = -label*exp((1-rho)s) + exp((2-rho)s)."""
+
+    name = "tweedie"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.rho = float(config.tweedie_variance_power)
+
+    def _check_label(self, label: np.ndarray) -> None:
+        if (label < 0).any():
+            log.fatal("[%s]: at least one target label is negative" % self.name)
+
+    @partial(jax.jit, static_argnums=0)
+    def _grads(self, score, label, weights):
+        exp_1 = jnp.exp((1.0 - self.rho) * score)
+        exp_2 = jnp.exp((2.0 - self.rho) * score)
+        grad = -label * exp_1 + exp_2
+        hess = (-label * (1.0 - self.rho) * exp_1
+                + (2.0 - self.rho) * exp_2)
+        return _apply_weight(grad, hess, weights)

@@ -1,0 +1,126 @@
+"""Cross-entropy objectives over [0,1]-valued labels.
+
+TPU-native equivalents of the reference's CrossEntropy /
+CrossEntropyLambda (reference: src/objective/xentropy_objective.hpp:21,148).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import log
+from .base import ObjectiveFunction
+
+_EPS = 1e-12
+
+
+class CrossEntropy(ObjectiveFunction):
+    """loss(y, p, w) = (-(1-y) log(1-p) - y log p) * w, p = sigmoid(score)
+    (reference: xentropy_objective.hpp:82-92): grad = z - y,
+    hess = z(1-z), scaled by weight."""
+
+    name = "cross_entropy"
+
+    def _check_label(self, label: np.ndarray) -> None:
+        if label.min() < 0.0 or label.max() > 1.0:
+            log.fatal("[%s]: label must be in [0, 1]" % self.name)
+        log.info("[%s]: (objective) labels passed interval [0, 1] check"
+                 % self.name)
+
+    def init(self, metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        if metadata.weights is not None:
+            w = np.asarray(metadata.weights)
+            if (w < 0).any():
+                log.fatal("[%s]: at least one weight is negative" % self.name)
+            if w.sum() == 0.0:
+                log.fatal("[%s]: sum of weights is zero" % self.name)
+
+    @partial(jax.jit, static_argnums=0)
+    def _grads(self, score, label, weights):
+        z = jax.nn.sigmoid(score)
+        grad = z - label
+        hess = z * (1.0 - z)
+        if weights is not None:
+            grad = grad * weights
+            hess = hess * weights
+        return grad, hess
+
+    def get_gradients(self, score):
+        return self._grads(score, self.label, self.weights)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        label = np.asarray(self.label, dtype=np.float64)
+        if self.weights is not None:
+            w = np.asarray(self.weights, dtype=np.float64)
+            pavg = (label * w).sum() / w.sum()
+        else:
+            pavg = label.mean()
+        pavg = min(max(pavg, _EPS), 1.0 - _EPS)
+        initscore = float(np.log(pavg / (1.0 - pavg)))
+        log.info("[%s:BoostFromScore]: pavg = %f -> initscore = %f"
+                 % (self.name, pavg, initscore))
+        return initscore
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + np.exp(-score))
+
+
+class CrossEntropyLambda(ObjectiveFunction):
+    """Alternative parameterization with p = 1 - exp(-lambda * w),
+    lambda = log(1 + exp(f)) (reference: xentropy_objective.hpp:148-216).
+    Unweighted it reduces to CrossEntropy."""
+
+    name = "cross_entropy_lambda"
+
+    def _check_label(self, label: np.ndarray) -> None:
+        if label.min() < 0.0 or label.max() > 1.0:
+            log.fatal("[%s]: label must be in [0, 1]" % self.name)
+
+    def init(self, metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        if metadata.weights is not None:
+            w = np.asarray(metadata.weights)
+            if (w <= 0).any():
+                log.fatal("[%s]: at least one weight is non-positive"
+                          % self.name)
+
+    @partial(jax.jit, static_argnums=0)
+    def _grads(self, score, label, weights):
+        if weights is None:
+            z = jax.nn.sigmoid(score)
+            return z - label, z * (1.0 - z)
+        w, y = weights, label
+        epf = jnp.exp(score)
+        hhat = jnp.log1p(epf)
+        z = 1.0 - jnp.exp(-w * hhat)
+        enf = 1.0 / epf
+        grad = (1.0 - y / z) * w / (1.0 + enf)
+        c = 1.0 / (1.0 - z)
+        d = 1.0 + epf
+        a = w * epf / (d * d)
+        d2 = c - 1.0
+        b = (c / (d2 * d2)) * (1.0 + w * epf - c)
+        hess = a * (1.0 + y * b)
+        return grad, hess
+
+    def get_gradients(self, score):
+        return self._grads(score, self.label, self.weights)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        label = np.asarray(self.label, dtype=np.float64)
+        if self.weights is not None:
+            w = np.asarray(self.weights, dtype=np.float64)
+            havg = (label * w).sum() / w.sum()
+        else:
+            havg = label.mean()
+        initscore = float(np.log(max(np.expm1(havg), _EPS)))
+        log.info("[%s:BoostFromScore]: havg = %f -> initscore = %f"
+                 % (self.name, havg, initscore))
+        return initscore
+
+    def convert_output(self, score):
+        return np.log1p(np.exp(score))

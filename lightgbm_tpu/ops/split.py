@@ -39,13 +39,18 @@ _NEG_INF = -jnp.inf
 class SplitParams(NamedTuple):
     """Scalar hyper-parameters of the split search (all traced, so one
     compiled kernel serves any setting). Mirror of the Config fields used by
-    the reference's FeatureHistogram (config.h:291-406)."""
+    the reference's FeatureHistogram (config.h:291-406; categorical knobs
+    :452-472)."""
     lambda_l1: jnp.ndarray
     lambda_l2: jnp.ndarray
     min_data_in_leaf: jnp.ndarray
     min_sum_hessian_in_leaf: jnp.ndarray
     min_gain_to_split: jnp.ndarray
     max_delta_step: jnp.ndarray
+    cat_l2: jnp.ndarray
+    cat_smooth: jnp.ndarray
+    min_data_per_group: jnp.ndarray
+    max_cat_threshold: jnp.ndarray
 
     @classmethod
     def from_config(cls, config) -> "SplitParams":
@@ -56,6 +61,10 @@ class SplitParams(NamedTuple):
             min_sum_hessian_in_leaf=jnp.float32(config.min_sum_hessian_in_leaf),
             min_gain_to_split=jnp.float32(config.min_gain_to_split),
             max_delta_step=jnp.float32(config.max_delta_step),
+            cat_l2=jnp.float32(config.cat_l2),
+            cat_smooth=jnp.float32(config.cat_smooth),
+            min_data_per_group=jnp.float32(config.min_data_per_group),
+            max_cat_threshold=jnp.int32(config.max_cat_threshold),
         )
 
 
@@ -65,34 +74,55 @@ class FeatureMeta(NamedTuple):
     num_bin: jnp.ndarray        # bins actually used by feature f
     missing_type: jnp.ndarray   # MissingType value
     zero_bin: jnp.ndarray       # bin holding value 0.0 (default_bin)
+    is_categorical: jnp.ndarray  # bool[F]
+    use_onehot: jnp.ndarray     # bool[F]: cat feature with few categories
+    monotone: jnp.ndarray       # i8[F]: -1/0/+1 monotone constraint
 
     @classmethod
-    def from_dataset(cls, dataset) -> "FeatureMeta":
+    def from_dataset(cls, dataset, max_cat_to_onehot: int = 4
+                     ) -> "FeatureMeta":
         import numpy as np
+        from ..io.binning import BinType
+        is_cat = np.asarray(
+            [m.bin_type == BinType.CATEGORICAL
+             for m in dataset.bin_mappers], dtype=bool)
+        num_bin = np.asarray(dataset.num_bin_per_feature, dtype=np.int32)
+        mc = dataset.monotone_constraints
+        monotone = (np.zeros(len(num_bin), dtype=np.int8) if mc is None
+                    else np.asarray(mc, dtype=np.int8))
         return cls(
-            num_bin=jnp.asarray(np.asarray(dataset.num_bin_per_feature,
-                                           dtype=np.int32)),
+            num_bin=jnp.asarray(num_bin),
             missing_type=jnp.asarray(
                 np.asarray([m.missing_type for m in dataset.bin_mappers],
                            dtype=np.int32)),
             zero_bin=jnp.asarray(
                 np.asarray([m.default_bin for m in dataset.bin_mappers],
                            dtype=np.int32)),
+            is_categorical=jnp.asarray(is_cat),
+            use_onehot=jnp.asarray(
+                is_cat & (num_bin <= max_cat_to_onehot)),
+            monotone=jnp.asarray(monotone),
         )
 
 
 class SplitInfo(NamedTuple):
-    """Best split of one leaf — all 0-d device arrays. The TPU analogue of
-    the reference's POD ``SplitInfo`` (src/treelearner/split_info.hpp:22).
+    """Best split of one leaf — all 0-d device arrays (except
+    ``cat_mask``). The TPU analogue of the reference's POD ``SplitInfo``
+    (src/treelearner/split_info.hpp:22).
 
     ``*_count`` are in-bag row counts (what min_data_in_leaf and leaf_count
     use, matching the reference under bagging); ``*_total_count`` count every
     partitioned row including out-of-bag ones — the learner sizes its row
-    compaction buffers with these."""
+    compaction buffers with these. For categorical winners
+    (``is_categorical``), ``cat_mask`` is the bool[B] set of bins routed
+    left (the device analogue of the reference's ``cat_threshold`` bin
+    list)."""
     gain: jnp.ndarray            # f32; relative gain (already minus shift); <=0 => invalid
     feature: jnp.ndarray         # i32 inner feature index; -1 if invalid
     threshold_bin: jnp.ndarray   # i32
     default_left: jnp.ndarray    # bool
+    is_categorical: jnp.ndarray  # bool
+    cat_mask: jnp.ndarray        # bool[B] — bins going left (cat only)
     left_sum_grad: jnp.ndarray   # f32
     left_sum_hess: jnp.ndarray
     left_count: jnp.ndarray      # f32 (exact for counts < 2^24)
@@ -103,6 +133,12 @@ class SplitInfo(NamedTuple):
     right_count: jnp.ndarray
     right_total_count: jnp.ndarray
     right_output: jnp.ndarray
+    # monotone-constraint bounds inherited by the children (reference:
+    # BasicLeafConstraints, src/treelearner/monotone_constraints.hpp)
+    left_min_output: jnp.ndarray
+    left_max_output: jnp.ndarray
+    right_min_output: jnp.ndarray
+    right_max_output: jnp.ndarray
 
 
 def threshold_l1(s: jnp.ndarray, l1: jnp.ndarray) -> jnp.ndarray:
@@ -111,25 +147,32 @@ def threshold_l1(s: jnp.ndarray, l1: jnp.ndarray) -> jnp.ndarray:
     return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
 
 
-def calculate_leaf_output(sum_grad, sum_hess, p: SplitParams):
+def calculate_leaf_output(sum_grad, sum_hess, p: SplitParams, l2=None):
     """Closed-form leaf weight (reference: CalculateSplittedLeafOutput,
-    feature_histogram.hpp:477+)."""
-    out = -threshold_l1(sum_grad, p.lambda_l1) / (sum_hess + p.lambda_l2)
+    feature_histogram.hpp:477+). ``l2`` overrides lambda_l2 (the
+    categorical path adds cat_l2, :384)."""
+    if l2 is None:
+        l2 = p.lambda_l2
+    out = -threshold_l1(sum_grad, p.lambda_l1) / (sum_hess + l2)
     return jnp.where(p.max_delta_step > 0.0,
                      jnp.clip(out, -p.max_delta_step, p.max_delta_step),
                      out)
 
 
-def leaf_gain_given_output(sum_grad, sum_hess, output, p: SplitParams):
+def leaf_gain_given_output(sum_grad, sum_hess, output, p: SplitParams,
+                           l2=None):
     """reference: GetLeafGainGivenOutput — exact also when the output was
     clipped by max_delta_step."""
+    if l2 is None:
+        l2 = p.lambda_l2
     sg = threshold_l1(sum_grad, p.lambda_l1)
-    return -(2.0 * sg * output + (sum_hess + p.lambda_l2) * output * output)
+    return -(2.0 * sg * output + (sum_hess + l2) * output * output)
 
 
-def leaf_gain(sum_grad, sum_hess, p: SplitParams):
+def leaf_gain(sum_grad, sum_hess, p: SplitParams, l2=None):
     return leaf_gain_given_output(
-        sum_grad, sum_hess, calculate_leaf_output(sum_grad, sum_hess, p), p)
+        sum_grad, sum_hess, calculate_leaf_output(sum_grad, sum_hess, p, l2),
+        p, l2)
 
 
 def find_best_split(hist: jnp.ndarray,
@@ -139,7 +182,9 @@ def find_best_split(hist: jnp.ndarray,
                     sum_total_count: jnp.ndarray,
                     meta: FeatureMeta,
                     params: SplitParams,
-                    feature_mask: jnp.ndarray) -> SplitInfo:
+                    feature_mask: jnp.ndarray,
+                    min_output=None,
+                    max_output=None) -> SplitInfo:
     """Scan a leaf histogram for the best (feature, threshold) pair.
 
     Parameters
@@ -154,7 +199,23 @@ def find_best_split(hist: jnp.ndarray,
     """
     F, B, _ = hist.shape
     g, h, c, tc = hist[..., 0], hist[..., 1], hist[..., 2], hist[..., 3]
+    if min_output is None:
+        min_output = jnp.float32(-jnp.inf)
+    if max_output is None:
+        max_output = jnp.float32(jnp.inf)
 
+    def bounded_output(sg, sh, l2=None):
+        return jnp.clip(calculate_leaf_output(sg, sh, params, l2),
+                        min_output, max_output)
+
+    def bounded_gain(sg, sh, l2=None):
+        return leaf_gain_given_output(
+            sg, sh, bounded_output(sg, sh, l2), params, l2)
+
+    is_cat = meta.is_categorical                                 # [F]
+    is_num = ~is_cat
+
+    # ---------------- numerical scan ----------------
     # Left-side stats for threshold t = sum over bins <= t.
     left_g = jnp.cumsum(g, axis=1)
     left_h = jnp.cumsum(h, axis=1)
@@ -177,7 +238,10 @@ def find_best_split(hist: jnp.ndarray,
     # NaN-missing features the NaN bin itself is not a threshold either
     # (reference scans value bins only).
     t_max = jnp.where(is_nan_missing[:, None], num_bin - 2, num_bin - 1)
-    valid_t = (bin_ids < t_max) & feature_mask[:, None]          # [F, B]
+    valid_t = (bin_ids < t_max) & feature_mask[:, None] \
+        & is_num[:, None]                                        # [F, B]
+
+    mono = meta.monotone.astype(jnp.int32)[:, None]              # [F, 1]
 
     def split_gain(lg, lh, lc):
         rg, rh, rc = sum_grad - lg, sum_hess - lh, sum_count - lc
@@ -185,8 +249,15 @@ def find_best_split(hist: jnp.ndarray,
               (rc >= params.min_data_in_leaf) &
               (lh >= params.min_sum_hessian_in_leaf) &
               (rh >= params.min_sum_hessian_in_leaf))
-        gain = (leaf_gain(lg, lh, params) + leaf_gain(rg, rh, params))
-        return jnp.where(ok & valid_t, gain, _NEG_INF)
+        out_l = bounded_output(lg, lh)
+        out_r = bounded_output(rg, rh)
+        # monotone filtering (reference: BasicLeafConstraints split
+        # rejection, monotone_constraints.hpp)
+        mono_ok = ~(((mono > 0) & (out_l > out_r))
+                    | ((mono < 0) & (out_l < out_r)))
+        gain = (leaf_gain_given_output(lg, lh, out_l, params)
+                + leaf_gain_given_output(rg, rh, out_r, params))
+        return jnp.where(ok & valid_t & mono_ok, gain, _NEG_INF)
 
     # Variant 0: natural placement (NaN bin stays right).
     gain_r = split_gain(left_g, left_h, left_c)
@@ -198,7 +269,98 @@ def find_best_split(hist: jnp.ndarray,
     # elsewhere so argmax tie-breaking is deterministic.
     gain_l = jnp.where(is_nan_missing[:, None], gain_l, _NEG_INF)
 
-    gains = jnp.stack([gain_r, gain_l])                          # [2, F, B]
+    # ---------------- categorical scans ----------------
+    # reference: FindBestThresholdCategoricalInner
+    # (src/treelearner/feature_histogram.hpp:278-520). Candidate bins are
+    # 1..num_bin-1 (bin 0 = NaN/other always routes right).
+    kEps = 1e-15
+    cat_bin_ok = ((bin_ids >= 1) & (bin_ids < num_bin)
+                  & is_cat[:, None] & feature_mask[:, None])     # [F, B]
+    sum_g_ = sum_grad
+    sum_h_ = sum_hess
+    sum_c_ = sum_count
+
+    # one-hot mode (num_bin <= max_cat_to_onehot; plain lambda_l2)
+    oh_ok = (cat_bin_ok & meta.use_onehot[:, None]
+             & (c >= params.min_data_in_leaf)
+             & (h >= params.min_sum_hessian_in_leaf)
+             & ((sum_c_ - c) >= params.min_data_in_leaf)
+             & ((sum_h_ - h - kEps)
+                >= params.min_sum_hessian_in_leaf))
+    gain_oh = bounded_gain(g, h + kEps) \
+        + bounded_gain(sum_g_ - g, sum_h_ - h - kEps)
+    gain_oh = jnp.where(oh_ok, gain_oh, _NEG_INF)
+
+    # sorted-subset mode (l2 += cat_l2; sort by g/(h+cat_smooth))
+    cat_l2 = params.lambda_l2 + params.cat_l2
+    sort_elig = (cat_bin_ok & ~meta.use_onehot[:, None]
+                 & (c >= params.cat_smooth))                     # [F, B]
+    used_bin = jnp.sum(sort_elig, axis=1).astype(jnp.int32)      # [F]
+    ratio = jnp.where(sort_elig, g / (h + params.cat_smooth), jnp.inf)
+    order = jnp.argsort(ratio, axis=1, stable=True)              # [F, B]
+    rank = jnp.argsort(order, axis=1, stable=True) \
+        .astype(jnp.int32)                                       # [F, B]
+    sg_s = jnp.take_along_axis(g, order, axis=1)
+    sh_s = jnp.take_along_axis(h, order, axis=1)
+    sc_s = jnp.take_along_axis(c, order, axis=1)
+    stc_s = jnp.take_along_axis(tc, order, axis=1)
+    max_num_cat = jnp.minimum(params.max_cat_threshold,
+                              (used_bin + 1) // 2)               # [F]
+
+    def cat_dir_scan(sgd, shd, scd, stcd):
+        """Prefix scan in one direction over sorted bins; returns
+        per-prefix gains [F, B] plus prefix stats."""
+        lg = jnp.cumsum(sgd, axis=1)
+        lh = jnp.cumsum(shd, axis=1) + kEps
+        lc = jnp.cumsum(scd, axis=1)
+        ltc = jnp.cumsum(stcd, axis=1)
+        rg, rh, rc = sum_g_ - lg, sum_h_ - lh, sum_c_ - lc
+        idx = jnp.arange(B, dtype=jnp.int32)[None, :]
+        pos_ok = (idx < used_bin[:, None]) & (idx < max_num_cat[:, None])
+        cont = (lc < params.min_data_in_leaf) \
+            | (lh < params.min_sum_hessian_in_leaf)
+        brk = (~cont) & ((rc < params.min_data_in_leaf)
+                         | (rc < params.min_data_per_group)
+                         | (rh < params.min_sum_hessian_in_leaf))
+        # sequential min_data_per_group batching (reference
+        # feature_histogram.hpp:443-447): accumulate counts, evaluate
+        # only when the running group reaches min_data_per_group, then
+        # reset. lax.scan over the (<=256) bin positions.
+        def step(carry, xs):
+            cnt_cur, broken = carry
+            cnt_i, cont_i, brk_i, pos_i = xs
+            cnt_cur = cnt_cur + cnt_i
+            can_eval = (pos_i & ~broken & ~cont_i & ~brk_i
+                        & (cnt_cur >= params.min_data_per_group))
+            cnt_cur = jnp.where(can_eval, 0.0, cnt_cur)
+            broken = broken | (brk_i & pos_i)
+            return (cnt_cur, broken), can_eval
+
+        (_, _), can_eval = jax.lax.scan(
+            step,
+            (jnp.zeros(F), jnp.zeros(F, dtype=bool)),
+            (scd.T, cont.T, brk.T, pos_ok.T))
+        can_eval = can_eval.T                                    # [F, B]
+        gains = bounded_gain(lg, lh, cat_l2) \
+            + bounded_gain(rg, rh, cat_l2)
+        return jnp.where(can_eval, gains, _NEG_INF), (lg, lh, lc, ltc)
+
+    gain_cs_f, stats_f = cat_dir_scan(sg_s, sh_s, sc_s, stc_s)
+    # reverse direction: prefixes from the high end of the sorted order,
+    # but only over the eligible (first used_bin) positions — roll the
+    # reversed arrays so eligible bins come first
+    def rev_eligible(a):
+        ar = jnp.flip(a, axis=1)
+        shift = B - used_bin                                    # [F]
+        idx = (jnp.arange(B, dtype=jnp.int32)[None, :]
+               + shift[:, None]) % B
+        return jnp.take_along_axis(ar, idx, axis=1)
+
+    gain_cs_r, stats_r = cat_dir_scan(
+        rev_eligible(sg_s), rev_eligible(sh_s), rev_eligible(sc_s),
+        rev_eligible(stc_s))
+
+    gains = jnp.stack([gain_r, gain_l, gain_oh, gain_cs_f, gain_cs_r])
     parent_gain = leaf_gain(sum_grad, sum_hess, params)
     shift = parent_gain + params.min_gain_to_split
 
@@ -208,12 +370,30 @@ def find_best_split(hist: jnp.ndarray,
     variant, rem = best // (F * B), best % (F * B)
     feature, tbin = (rem // B).astype(jnp.int32), (rem % B).astype(jnp.int32)
 
-    # Reconstruct the winning split's stats.
+    # Reconstruct the winning split's stats per variant.
     is_l = variant == 1
-    lg = left_g[feature, tbin] + jnp.where(is_l, nan_g[feature], 0.0)
-    lh = left_h[feature, tbin] + jnp.where(is_l, nan_h[feature], 0.0)
-    lc = left_c[feature, tbin] + jnp.where(is_l, nan_c[feature], 0.0)
-    ltc = left_tc[feature, tbin] + jnp.where(is_l, nan_tc[feature], 0.0)
+    lg_n = left_g[feature, tbin] + jnp.where(is_l, nan_g[feature], 0.0)
+    lh_n = left_h[feature, tbin] + jnp.where(is_l, nan_h[feature], 0.0)
+    lc_n = left_c[feature, tbin] + jnp.where(is_l, nan_c[feature], 0.0)
+    ltc_n = left_tc[feature, tbin] + jnp.where(is_l, nan_tc[feature], 0.0)
+
+    winner_is_cat = variant >= 2
+    lg = jnp.select(
+        [variant <= 1, variant == 2, variant == 3, variant == 4],
+        [lg_n, g[feature, tbin], stats_f[0][feature, tbin],
+         stats_r[0][feature, tbin]])
+    lh = jnp.select(
+        [variant <= 1, variant == 2, variant == 3, variant == 4],
+        [lh_n, h[feature, tbin] + kEps, stats_f[1][feature, tbin],
+         stats_r[1][feature, tbin]])
+    lc = jnp.select(
+        [variant <= 1, variant == 2, variant == 3, variant == 4],
+        [lc_n, c[feature, tbin], stats_f[2][feature, tbin],
+         stats_r[2][feature, tbin]])
+    ltc = jnp.select(
+        [variant <= 1, variant == 2, variant == 3, variant == 4],
+        [ltc_n, tc[feature, tbin], stats_f[3][feature, tbin],
+         stats_r[3][feature, tbin]])
     rg, rh, rc = sum_grad - lg, sum_hess - lh, sum_count - lc
     rtc = sum_total_count - ltc
 
@@ -221,19 +401,55 @@ def find_best_split(hist: jnp.ndarray,
     is_valid = jnp.isfinite(best_gain_abs) & (gain_rel > 0.0)
 
     default_left = jnp.where(
-        is_nan_missing[feature], variant == 1,
-        (meta.missing_type[feature] == MissingType.ZERO)
-        & (meta.zero_bin[feature] <= tbin))
+        winner_is_cat, False,
+        jnp.where(is_nan_missing[feature], variant == 1,
+                  (meta.missing_type[feature] == MissingType.ZERO)
+                  & (meta.zero_bin[feature] <= tbin)))
 
+    # categorical left-bin mask: one-hot → {tbin}; sorted fwd → sorted
+    # rank <= tbin; sorted rev → the tbin+1 highest-ratio eligible bins
+    rk = rank[feature]                                           # [B]
+    ub = used_bin[feature]
+    mask_oh = jnp.arange(B, dtype=jnp.int32) == tbin
+    mask_fwd = rk <= tbin
+    mask_rev = (rk >= ub - 1 - tbin) & (rk < ub)
+    elig_row = sort_elig[feature]
+    cat_mask = jnp.select(
+        [variant == 2, variant == 3, variant == 4],
+        [mask_oh, mask_fwd & elig_row, mask_rev & elig_row],
+        jnp.zeros(B, dtype=bool))
+
+    out_l2 = jnp.where(variant >= 3, cat_l2, params.lambda_l2)
+    out_left = jnp.clip(calculate_leaf_output(lg, lh, params, out_l2),
+                        min_output, max_output)
+    out_right = jnp.clip(calculate_leaf_output(rg, rh, params, out_l2),
+                         min_output, max_output)
+    # children bounds (reference: BasicLeafConstraints::Update — the
+    # mid-point between child outputs caps the monotone side)
+    mc_w = jnp.where(winner_is_cat, 0,
+                     meta.monotone[feature].astype(jnp.int32))
+    mid = (out_left + out_right) / 2.0
+    left_max = jnp.where(mc_w > 0, jnp.minimum(max_output, mid),
+                         max_output)
+    right_min = jnp.where(mc_w > 0, jnp.maximum(min_output, mid),
+                          min_output)
+    left_min = jnp.where(mc_w < 0, jnp.maximum(min_output, mid),
+                         min_output)
+    right_max = jnp.where(mc_w < 0, jnp.minimum(max_output, mid),
+                          max_output)
     return SplitInfo(
         gain=jnp.where(is_valid, gain_rel, _NEG_INF).astype(jnp.float32),
         feature=jnp.where(is_valid, feature, -1),
         threshold_bin=tbin,
         default_left=default_left,
+        is_categorical=winner_is_cat,
+        cat_mask=cat_mask,
         left_sum_grad=lg, left_sum_hess=lh, left_count=lc,
         left_total_count=ltc,
-        left_output=calculate_leaf_output(lg, lh, params),
+        left_output=out_left,
         right_sum_grad=rg, right_sum_hess=rh, right_count=rc,
         right_total_count=rtc,
-        right_output=calculate_leaf_output(rg, rh, params),
+        right_output=out_right,
+        left_min_output=left_min, left_max_output=left_max,
+        right_min_output=right_min, right_max_output=right_max,
     )

@@ -1,0 +1,19 @@
+"""Refresh a live learner's device-side split parameters after
+``reset_parameter`` (reference: GBDT::ResetConfig →
+TreeLearner::ResetConfig, serial_tree_learner.cpp). SplitParams fields are
+traced values, so replacing the NamedTuple reuses the compiled kernels."""
+from __future__ import annotations
+
+from .ops.split import SplitParams
+
+
+def refresh_learner_params(learner, config) -> None:
+    learner.params = SplitParams.from_config(config)
+    learner.max_depth = int(config.max_depth)
+    # jitted step closures bake the old params as constants — drop them
+    # so the next tree re-traces with the new values
+    if hasattr(learner, "_step_cache"):
+        learner._step_cache.clear()
+    if hasattr(learner, "_root_impl"):
+        import jax
+        learner._root_fn = jax.jit(learner._root_impl)

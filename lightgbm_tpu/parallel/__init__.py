@@ -1,0 +1,17 @@
+"""Distributed training over a ``jax.sharding.Mesh``.
+
+TPU-native replacement for the reference's network + parallel-learner
+layers (reference: src/network/ — TCP/MPI collectives;
+src/treelearner/data_parallel_tree_learner.cpp,
+feature_parallel_tree_learner.cpp, voting_parallel_tree_learner.cpp).
+Machine lists, listen ports and socket bootstrap have no TPU analogue:
+an ICI/DCN mesh plus GSPMD sharding constraints make XLA insert the
+collectives (psum ≙ Allreduce, psum_scatter ≙ ReduceScatter+
+HistogramSumReducer, all_gather ≙ Allgather).
+"""
+from .data_parallel import DataParallelTreeLearner, make_mesh
+from .feature_parallel import FeatureParallelTreeLearner
+from .voting_parallel import VotingParallelTreeLearner
+
+__all__ = ["DataParallelTreeLearner", "FeatureParallelTreeLearner",
+           "VotingParallelTreeLearner", "make_mesh"]

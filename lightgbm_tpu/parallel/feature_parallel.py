@@ -1,0 +1,96 @@
+"""Feature-parallel tree learner: features sharded over the mesh axis.
+
+TPU-native equivalent of the reference's ``FeatureParallelTreeLearner``
+(reference: src/treelearner/feature_parallel_tree_learner.cpp: every rank
+holds all rows but owns a feature subset; after finding its local best
+split, ranks agree via ``SyncUpGlobalBestSplit`` — an Allreduce with a
+max-gain reducer, parallel_tree_learner.h:190). Here the bin matrix is
+sharded [rows, FEATURES→mesh] so each device histograms and scans only its
+feature block; the winning (gain, feature) argmax is a replicated scalar
+reduction XLA lowers to the same max-Allreduce; the partition update reads
+one feature column (a one-column all-gather, the analogue of every rank
+splitting locally since all ranks hold all data).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..io.dataset import BinnedDataset
+from ..models.tree import Tree
+from ..ops.histogram import build_histogram, subtract_histogram
+from ..ops.split import FeatureMeta, SplitParams, find_best_split
+from ..treelearner.serial import (GrowState, _go_left_by_bin, _record_at,
+                                  _store_info, _NEG_INF)
+from .data_parallel import DataParallelTreeLearner
+
+
+class FeatureParallelTreeLearner(DataParallelTreeLearner):
+    """Same host loop and step dataflow as the data-parallel learner, but
+    sharded over features instead of rows. Rows are replicated (the
+    reference's "all ranks hold all data"), so the partition update is
+    fully local and the histogram needs no cross-device reduction at all —
+    only the best-split argmax crosses devices."""
+
+    def __init__(self, config, dataset: BinnedDataset, mesh: Mesh,
+                 axis: str = "data"):
+        # pad the FEATURE axis to a devices multiple before sharding
+        super().__init__(config, dataset, mesh, axis)
+        n_dev = mesh.devices.size
+        N, F = dataset.bins.shape
+        Fp = -(-F // n_dev) * n_dev
+        pad = np.zeros((N, Fp - F), dtype=dataset.bins.dtype)
+        bins_host = np.concatenate([dataset.bins, pad], axis=1)
+        # rows replicated, features sharded
+        self.R = N
+        self.F_pad = Fp
+        self.bins = jax.device_put(
+            bins_host, NamedSharding(mesh, P(None, self.axis)))
+        self.row_sharding = NamedSharding(mesh, P())  # rows replicated
+        # feature metadata padded to Fp: padded features are trivial
+        # (num_bin 1 → never valid thresholds)
+        meta = FeatureMeta.from_dataset(dataset,
+                                        int(config.max_cat_to_onehot))
+        padF = Fp - F
+
+        def padv(a, fill):
+            return jnp.concatenate(
+                [a, jnp.full((padF,), fill, dtype=a.dtype)])
+
+        self.meta = FeatureMeta(
+            num_bin=padv(meta.num_bin, 1),
+            missing_type=padv(meta.missing_type, 0),
+            zero_bin=padv(meta.zero_bin, 0),
+            is_categorical=padv(meta.is_categorical, False),
+            use_onehot=padv(meta.use_onehot, False),
+            monotone=padv(meta.monotone, 0),
+        )
+        self.meta = jax.device_put(self.meta, self.rep_sharding)
+        self.F = Fp
+        # keep histograms feature-sharded; only the argmax crosses devices
+        self.hist_sharding = NamedSharding(mesh, P(self.axis, None, None))
+        self.gh_sharding = NamedSharding(mesh, P(None, None))  # replicated
+
+    def _sample_features(self) -> jnp.ndarray:
+        mask = np.zeros(self.F_pad, dtype=bool)
+        real_f = len(self.dataset.bin_mappers)
+        base = np.ones(real_f, dtype=bool)
+        ff = float(self.config.feature_fraction)
+        if 0.0 < ff < 1.0:
+            k = max(1, int(round(real_f * ff)))
+            base[:] = False
+            base[self._ff_rng.choice(real_f, k, replace=False)] = True
+        mask[:real_f] = base
+        return jax.device_put(jnp.asarray(mask), self.rep_sharding)
+
+    def _step_impl(self, state, leaf, new_leaf, children_allowed,
+                   feature_mask):
+        # identical dataflow to the data-parallel step; the sharding of
+        # self.bins (features) makes the histogram feature-sharded and
+        # the partition column-gather cross-device
+        return super()._step_impl(state, leaf, new_leaf, children_allowed,
+                                  feature_mask)

@@ -1,0 +1,297 @@
+"""scikit-learn estimator wrappers.
+
+API-shaped after the reference's python-package/lightgbm/sklearn.py
+(``LGBMModel`` :364, ``LGBMRegressor`` :989, ``LGBMClassifier`` :1035,
+``LGBMRanker`` :1212).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .engine import train as _train
+from .utils import log
+
+
+class LGBMModel:
+    """Base estimator (reference: sklearn.py:364)."""
+
+    def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
+                 max_depth: int = -1, learning_rate: float = 0.1,
+                 n_estimators: int = 100, subsample_for_bin: int = 200000,
+                 objective: Optional[Union[str, Callable]] = None,
+                 class_weight=None, min_split_gain: float = 0.0,
+                 min_child_weight: float = 1e-3, min_child_samples: int = 20,
+                 subsample: float = 1.0, subsample_freq: int = 0,
+                 colsample_bytree: float = 1.0, reg_alpha: float = 0.0,
+                 reg_lambda: float = 0.0, random_state=None,
+                 n_jobs: int = -1, importance_type: str = "split",
+                 **kwargs):
+        self.boosting_type = boosting_type
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.objective = objective
+        self.class_weight = class_weight
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.importance_type = importance_type
+        self._other_params = dict(kwargs)
+        self._Booster: Optional[Booster] = None
+        self._evals_result: Dict = {}
+        self._best_iteration = -1
+        self.fitted_ = False
+
+    # ------------------------------------------------------------------
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        params = {
+            "boosting_type": self.boosting_type,
+            "num_leaves": self.num_leaves, "max_depth": self.max_depth,
+            "learning_rate": self.learning_rate,
+            "n_estimators": self.n_estimators,
+            "subsample_for_bin": self.subsample_for_bin,
+            "objective": self.objective,
+            "class_weight": self.class_weight,
+            "min_split_gain": self.min_split_gain,
+            "min_child_weight": self.min_child_weight,
+            "min_child_samples": self.min_child_samples,
+            "subsample": self.subsample,
+            "subsample_freq": self.subsample_freq,
+            "colsample_bytree": self.colsample_bytree,
+            "reg_alpha": self.reg_alpha, "reg_lambda": self.reg_lambda,
+            "random_state": self.random_state, "n_jobs": self.n_jobs,
+            "importance_type": self.importance_type,
+        }
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params) -> "LGBMModel":
+        for key, value in params.items():
+            if hasattr(self, key):
+                setattr(self, key, value)
+            else:
+                self._other_params[key] = value
+        return self
+
+    # ------------------------------------------------------------------
+    def _default_objective(self) -> str:
+        return "regression"
+
+    def _process_params(self) -> Dict[str, Any]:
+        params = self.get_params()
+        params.pop("importance_type", None)
+        params.pop("n_estimators", None)
+        params.pop("class_weight", None)
+        objective = params.pop("objective", None)
+        if objective is None:
+            objective = self._default_objective()
+        params["objective"] = objective
+        params["boosting"] = params.pop("boosting_type", "gbdt")
+        if params.get("random_state") is None:
+            params.pop("random_state", None)
+        else:
+            params["seed"] = params.pop("random_state")
+        params.pop("n_jobs", None)
+        params.pop("silent", None)
+        params.setdefault("verbosity", -1)
+        return params
+
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_init_score=None, eval_group=None, eval_metric=None,
+            feature_name="auto", categorical_feature="auto",
+            callbacks=None) -> "LGBMModel":
+        params = self._process_params()
+        if eval_metric is not None:
+            params["metric"] = eval_metric
+        if self.class_weight is not None:
+            sample_weight = _apply_class_weight(
+                self.class_weight, y, sample_weight)
+        train_set = Dataset(X, label=y, weight=sample_weight, group=group,
+                            init_score=init_score, params=params,
+                            feature_name=feature_name,
+                            categorical_feature=categorical_feature)
+        valid_sets = []
+        if eval_set is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            for i, (vx, vy) in enumerate(eval_set):
+                vw = (eval_sample_weight[i]
+                      if eval_sample_weight else None)
+                vg = eval_group[i] if eval_group else None
+                vi = eval_init_score[i] if eval_init_score else None
+                valid_sets.append(Dataset(
+                    vx, label=vy, weight=vw, group=vg, init_score=vi,
+                    reference=train_set, params=params))
+        self._evals_result = {}
+        callbacks = list(callbacks or [])
+        if valid_sets:
+            callbacks.append(
+                callback_mod.record_evaluation(self._evals_result))
+        self._Booster = _train(
+            params, train_set, num_boost_round=self.n_estimators,
+            valid_sets=valid_sets, valid_names=eval_names,
+            callbacks=callbacks)
+        self._best_iteration = self._Booster.best_iteration
+        self._n_features = train_set.num_feature()
+        self.fitted_ = True
+        return self
+
+    # ------------------------------------------------------------------
+    def predict(self, X, raw_score: bool = False,
+                start_iteration: int = 0,
+                num_iteration: Optional[int] = None,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                **kwargs):
+        self._check_fitted()
+        return self._Booster.predict(
+            X, raw_score=raw_score, start_iteration=start_iteration,
+            num_iteration=num_iteration, pred_leaf=pred_leaf,
+            pred_contrib=pred_contrib)
+
+    def _check_fitted(self):
+        if not self.fitted_:
+            raise ValueError(
+                "Estimator not fitted, call fit before exploiting the model.")
+
+    @property
+    def booster_(self) -> Booster:
+        self._check_fitted()
+        return self._Booster
+
+    @property
+    def best_iteration_(self) -> int:
+        self._check_fitted()
+        return self._best_iteration
+
+    @property
+    def evals_result_(self) -> Dict:
+        self._check_fitted()
+        return self._evals_result
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        self._check_fitted()
+        return self._Booster.feature_importance(self.importance_type)
+
+    @property
+    def n_features_(self) -> int:
+        self._check_fitted()
+        return self._n_features
+
+    @property
+    def feature_name_(self) -> List[str]:
+        self._check_fitted()
+        return self._Booster.feature_name()
+
+
+class LGBMRegressor(LGBMModel):
+    """reference: sklearn.py:989."""
+
+    def _default_objective(self) -> str:
+        return "regression"
+
+
+class LGBMClassifier(LGBMModel):
+    """reference: sklearn.py:1035."""
+
+    def _default_objective(self) -> str:
+        return "binary"
+
+    def fit(self, X, y, **kwargs):
+        y = np.asarray(y)
+        self._classes = np.unique(y)
+        self._n_classes = len(self._classes)
+        if self._n_classes > 2:
+            if not isinstance(self.objective, str) or \
+                    self.objective not in ("multiclass", "multiclassova"):
+                self.objective = "multiclass"
+            self._other_params["num_class"] = self._n_classes
+        y_enc = np.searchsorted(self._classes, y).astype(np.float64)
+        super().fit(X, y_enc, **kwargs)
+        return self
+
+    def _default_objective_multiclass(self):
+        return "multiclass"
+
+    def predict(self, X, raw_score: bool = False, start_iteration: int = 0,
+                num_iteration: Optional[int] = None, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs):
+        result = self.predict_proba(
+            X, raw_score=raw_score, start_iteration=start_iteration,
+            num_iteration=num_iteration, pred_leaf=pred_leaf,
+            pred_contrib=pred_contrib)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if result.ndim > 1:
+            idx = np.argmax(result, axis=1)
+        else:
+            idx = (result > 0.5).astype(int)
+        return self._classes[idx]
+
+    def predict_proba(self, X, raw_score: bool = False,
+                      start_iteration: int = 0,
+                      num_iteration: Optional[int] = None,
+                      pred_leaf: bool = False, pred_contrib: bool = False,
+                      **kwargs):
+        self._check_fitted()
+        result = self._Booster.predict(
+            X, raw_score=raw_score, start_iteration=start_iteration,
+            num_iteration=num_iteration, pred_leaf=pred_leaf,
+            pred_contrib=pred_contrib)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if result.ndim == 1:
+            return np.vstack([1.0 - result, result]).T
+        return result
+
+    @property
+    def classes_(self) -> np.ndarray:
+        self._check_fitted()
+        return self._classes
+
+    @property
+    def n_classes_(self) -> int:
+        self._check_fitted()
+        return self._n_classes
+
+
+class LGBMRanker(LGBMModel):
+    """reference: sklearn.py:1212."""
+
+    def _default_objective(self) -> str:
+        return "lambdarank"
+
+    def fit(self, X, y, group=None, **kwargs):
+        if group is None:
+            raise ValueError("Should set group for ranking task")
+        return super().fit(X, y, group=group, **kwargs)
+
+
+def _apply_class_weight(class_weight, y, sample_weight):
+    y = np.asarray(y)
+    if class_weight == "balanced":
+        classes, counts = np.unique(y, return_counts=True)
+        weight_map = {c: len(y) / (len(classes) * cnt)
+                      for c, cnt in zip(classes, counts)}
+    elif isinstance(class_weight, dict):
+        weight_map = class_weight
+    else:
+        raise ValueError("class_weight must be 'balanced' or a dict")
+    cw = np.array([weight_map.get(v, 1.0) for v in y])
+    if sample_weight is not None:
+        cw = cw * np.asarray(sample_weight)
+    return cw

@@ -1,1 +1,39 @@
-from .serial import SerialTreeLearner  # noqa: F401
+"""Tree-learner factory.
+
+Equivalent of the reference's ``TreeLearner::CreateTreeLearner``
+(reference: src/treelearner/tree_learner.cpp:15-55 — keyed on
+``tree_learner`` ∈ serial/feature/data/voting × ``device_type``). On TPU
+the device dimension collapses: every learner runs on the accelerator;
+the parallel variants differ only in how they shard over the mesh.
+"""
+from __future__ import annotations
+
+from ..utils import log
+from .serial import SerialTreeLearner
+
+
+def create_tree_learner(config, dataset, mesh=None):
+    name = getattr(config, "tree_learner", "serial")
+    if name in ("serial",):
+        return SerialTreeLearner(config, dataset)
+    import jax
+    from ..parallel import (DataParallelTreeLearner,
+                            FeatureParallelTreeLearner,
+                            VotingParallelTreeLearner, make_mesh)
+    if mesh is None:
+        if len(jax.devices()) < 2:
+            log.warning(
+                "tree_learner=%s requested but only one device is "
+                "visible; falling back to serial" % name)
+            return SerialTreeLearner(config, dataset)
+        mesh = make_mesh()
+    if name in ("data", "data_parallel"):
+        return DataParallelTreeLearner(config, dataset, mesh)
+    if name in ("feature", "feature_parallel"):
+        return FeatureParallelTreeLearner(config, dataset, mesh)
+    if name in ("voting", "voting_parallel"):
+        return VotingParallelTreeLearner(config, dataset, mesh)
+    log.fatal("Unknown tree learner type %s" % name)
+
+
+__all__ = ["SerialTreeLearner", "create_tree_learner"]

@@ -68,6 +68,13 @@ class GrowState(NamedTuple):
     feature: jnp.ndarray          # [L] i32
     threshold_bin: jnp.ndarray    # [L] i32
     default_left: jnp.ndarray     # [L] bool
+    is_categorical: jnp.ndarray   # [L] bool
+    cat_mask: jnp.ndarray         # [L, B] bool — bins going left (cat)
+    # monotone bounds each candidate's children would inherit
+    cand_left_min: jnp.ndarray    # [L] f32
+    cand_left_max: jnp.ndarray
+    cand_right_min: jnp.ndarray
+    cand_right_max: jnp.ndarray
     left_sum_grad: jnp.ndarray    # [L] f32
     left_sum_hess: jnp.ndarray
     left_count: jnp.ndarray
@@ -87,6 +94,8 @@ class SplitRecord(NamedTuple):
     feature: jnp.ndarray
     threshold_bin: jnp.ndarray
     default_left: jnp.ndarray
+    is_categorical: jnp.ndarray
+    cat_mask: jnp.ndarray
     left_sum_grad: jnp.ndarray
     left_sum_hess: jnp.ndarray
     left_count: jnp.ndarray
@@ -104,6 +113,8 @@ def _record_at(state: GrowState, leaf) -> SplitRecord:
         leaf=leaf, gain=state.gain[leaf], feature=state.feature[leaf],
         threshold_bin=state.threshold_bin[leaf],
         default_left=state.default_left[leaf],
+        is_categorical=state.is_categorical[leaf],
+        cat_mask=state.cat_mask[leaf],
         left_sum_grad=state.left_sum_grad[leaf],
         left_sum_hess=state.left_sum_hess[leaf],
         left_count=state.left_count[leaf],
@@ -123,6 +134,17 @@ def _store_info(state: GrowState, leaf, info: SplitInfo,
         feature=state.feature.at[leaf].set(info.feature),
         threshold_bin=state.threshold_bin.at[leaf].set(info.threshold_bin),
         default_left=state.default_left.at[leaf].set(info.default_left),
+        is_categorical=state.is_categorical.at[leaf].set(
+            info.is_categorical),
+        cat_mask=state.cat_mask.at[leaf].set(info.cat_mask),
+        cand_left_min=state.cand_left_min.at[leaf].set(
+            info.left_min_output),
+        cand_left_max=state.cand_left_max.at[leaf].set(
+            info.left_max_output),
+        cand_right_min=state.cand_right_min.at[leaf].set(
+            info.right_min_output),
+        cand_right_max=state.cand_right_max.at[leaf].set(
+            info.right_max_output),
         left_sum_grad=state.left_sum_grad.at[leaf].set(info.left_sum_grad),
         left_sum_hess=state.left_sum_hess.at[leaf].set(info.left_sum_hess),
         left_count=state.left_count.at[leaf].set(info.left_count),
@@ -138,14 +160,18 @@ def _store_info(state: GrowState, leaf, info: SplitInfo,
 
 
 def _go_left_by_bin(col: jnp.ndarray, tbin, default_left,
-                    missing_type, nan_bin, zero_bin) -> jnp.ndarray:
+                    missing_type, nan_bin, zero_bin,
+                    is_categorical=None, cat_mask=None) -> jnp.ndarray:
     """Training-time split direction over bin values (reference:
-    DenseBin::Split templated missing handling, src/io/dense_bin.hpp)."""
+    DenseBin::Split templated missing handling, src/io/dense_bin.hpp;
+    categorical bitset routing ≙ DenseBin::SplitCategorical)."""
     gl = col <= tbin
     gl = jnp.where((missing_type == MissingType.NAN) & (col == nan_bin),
                    default_left, gl)
     gl = jnp.where((missing_type == MissingType.ZERO) & (col == zero_bin),
                    default_left, gl)
+    if is_categorical is not None:
+        gl = jnp.where(is_categorical, cat_mask[col], gl)
     return gl
 
 
@@ -165,9 +191,11 @@ class SerialTreeLearner:
         # dummy row N: bins 0, gh 0, leaf -1
         pad = np.zeros((1, F), dtype=dataset.bins.dtype)
         self.bins = jnp.asarray(np.concatenate([dataset.bins, pad], axis=0))
-        self.meta = FeatureMeta.from_dataset(dataset)
+        self.meta = FeatureMeta.from_dataset(
+            dataset, int(config.max_cat_to_onehot))
         self.params = SplitParams.from_config(config)
         self._ff_rng = np.random.RandomState(config.feature_fraction_seed)
+        self._resolve_constraints()
         self._step_cache = {}
         self._root_fn = jax.jit(self._root_impl)
         self._max_bucket = _next_pow2(N)
@@ -182,7 +210,52 @@ class SerialTreeLearner:
             k = max(1, int(round(self.F * ff)))
             mask[:] = False
             mask[self._ff_rng.choice(self.F, k, replace=False)] = True
+        if self._constraint_groups is not None:
+            allowed = np.zeros(self.F, dtype=bool)
+            for grp in self._constraint_groups:
+                allowed[list(grp)] = True
+            mask &= allowed
         return jnp.asarray(mask)
+
+    def _resolve_constraints(self):
+        """interaction_constraints (config.h:562): groups of inner feature
+        indices; a branch may only combine features co-occurring in at
+        least one group (reference: ColSampler::SetUsedFeatureByNode)."""
+        ic = self.config.interaction_constraints
+        if not ic:
+            self._constraint_groups = None
+            return
+        groups = []
+        for grp in ic:
+            inner = set()
+            for real_f in grp:
+                j = self.dataset.inner_feature_index(int(real_f))
+                if j >= 0:
+                    inner.add(j)
+            if inner:
+                groups.append(frozenset(inner))
+        self._constraint_groups = groups or None
+
+    def _node_mask(self, tree_mask: jnp.ndarray,
+                   path_features: frozenset) -> jnp.ndarray:
+        """Per-node mask: interaction constraints filtered by the
+        feature-path, plus feature_fraction_bynode sampling."""
+        mask = None
+        if self._constraint_groups is not None:
+            allowed = np.zeros(self.F, dtype=bool)
+            for grp in self._constraint_groups:
+                if path_features <= grp:
+                    allowed[list(grp)] = True
+            mask = allowed
+        ffb = float(self.config.feature_fraction_bynode)
+        if 0.0 < ffb < 1.0:
+            m2 = np.zeros(self.F, dtype=bool)
+            k = max(1, int(round(self.F * ffb)))
+            m2[self._ff_rng.choice(self.F, k, replace=False)] = True
+            mask = m2 if mask is None else (mask & m2)
+        if mask is None:
+            return tree_mask
+        return tree_mask & jnp.asarray(mask)
 
     # ------------------------------------------------------------------
     def _root_impl(self, gh: jnp.ndarray, feature_mask: jnp.ndarray,
@@ -203,6 +276,12 @@ class SerialTreeLearner:
             feature=jnp.full(L, -1, dtype=jnp.int32),
             threshold_bin=jnp.zeros(L, dtype=jnp.int32),
             default_left=jnp.zeros(L, dtype=bool),
+            is_categorical=jnp.zeros(L, dtype=bool),
+            cat_mask=jnp.zeros((L, B), dtype=bool),
+            cand_left_min=jnp.full(L, -jnp.inf, dtype=jnp.float32),
+            cand_left_max=jnp.full(L, jnp.inf, dtype=jnp.float32),
+            cand_right_min=jnp.full(L, -jnp.inf, dtype=jnp.float32),
+            cand_right_max=jnp.full(L, jnp.inf, dtype=jnp.float32),
             left_sum_grad=zf(), left_sum_hess=zf(), left_count=zf(),
             left_total_count=zf(), left_output=zf(), right_sum_grad=zf(),
             right_sum_hess=zf(), right_count=zf(), right_total_count=zf(),
@@ -217,13 +296,15 @@ class SerialTreeLearner:
         R = self.N + 1
 
         def step(state: GrowState, leaf, new_leaf, children_allowed,
-                 feature_mask):
+                 mask_left, mask_right):
             f = state.feature[leaf]
             tbin = state.threshold_bin[leaf]
             dl = state.default_left[leaf]
             col = jnp.take(bins, f, axis=1).astype(jnp.int32)
             gl = _go_left_by_bin(col, tbin, dl, meta.missing_type[f],
-                                 meta.num_bin[f] - 1, meta.zero_bin[f])
+                                 meta.num_bin[f] - 1, meta.zero_bin[f],
+                                 state.is_categorical[leaf],
+                                 state.cat_mask[leaf])
             on_leaf = state.leaf_of_row == leaf
             leaf_of_row = jnp.where(on_leaf & ~gl, new_leaf,
                                     state.leaf_of_row)
@@ -245,11 +326,13 @@ class SerialTreeLearner:
             left_info = find_best_split(
                 hist_left, state.left_sum_grad[leaf],
                 state.left_sum_hess[leaf], lc, ltc, meta, params,
-                feature_mask)
+                mask_left, state.cand_left_min[leaf],
+                state.cand_left_max[leaf])
             right_info = find_best_split(
                 hist_right, state.right_sum_grad[leaf],
                 state.right_sum_hess[leaf], rc, rtc, meta, params,
-                feature_mask)
+                mask_right, state.cand_right_min[leaf],
+                state.cand_right_max[leaf])
 
             state = state._replace(leaf_of_row=leaf_of_row, hists=hists)
             state = _store_info(state, leaf, left_info, children_allowed)
@@ -293,6 +376,11 @@ class SerialTreeLearner:
         tree = Tree(self.L)
         state, rec = self._root_fn(gh, feature_mask, self._splittable(0))
         pending = jax.device_get(rec)
+        # per-leaf feature path (for interaction constraints / bynode)
+        paths = {0: frozenset()}
+        per_node = (self._constraint_groups is not None
+                    or 0.0 < float(self.config.feature_fraction_bynode)
+                    < 1.0)
         for k in range(1, self.L):
             leaf = int(pending.leaf)
             if int(pending.feature) < 0 or not np.isfinite(float(pending.gain)) \
@@ -301,24 +389,41 @@ class SerialTreeLearner:
             f = int(pending.feature)
             tbin = int(pending.threshold_bin)
             mapper = self.dataset.bin_mappers[f]
-            tree.split(
+            common = dict(
                 leaf=leaf, feature=self.dataset.real_feature_index(f),
-                feature_inner=f, threshold_bin=tbin,
-                threshold_real=self.dataset.real_threshold(f, tbin),
+                feature_inner=f,
                 left_value=float(pending.left_output),
                 right_value=float(pending.right_output),
                 left_count=int(round(float(pending.left_count))),
                 right_count=int(round(float(pending.right_count))),
                 left_weight=float(pending.left_sum_hess),
                 right_weight=float(pending.right_sum_hess),
-                gain=float(pending.gain), missing_type=mapper.missing_type,
-                default_left=bool(pending.default_left))
+                gain=float(pending.gain))
+            if bool(pending.is_categorical):
+                bin_mask = np.asarray(pending.cat_mask)
+                cats = [mapper.bin_2_categorical[b]
+                        for b in np.nonzero(bin_mask)[0]
+                        if b < len(mapper.bin_2_categorical)]
+                tree.split_categorical(
+                    cat_values=cats, bin_mask=bin_mask, **common)
+            else:
+                tree.split(
+                    threshold_bin=tbin,
+                    threshold_real=self.dataset.real_threshold(f, tbin),
+                    missing_type=mapper.missing_type,
+                    default_left=bool(pending.default_left), **common)
             children_allowed = self._splittable(int(tree.leaf_depth[leaf]))
             smaller = min(float(pending.left_total_count),
                           float(pending.right_total_count))
             S = self._bucket(smaller)
+            paths[leaf] = paths[k] = paths.get(leaf, frozenset()) | {f}
+            if per_node:
+                mask_left = self._node_mask(feature_mask, paths[leaf])
+                mask_right = self._node_mask(feature_mask, paths[k])
+            else:
+                mask_left = mask_right = feature_mask
             state, rec = self._step_fn(S)(
                 state, jnp.int32(leaf), jnp.int32(k),
-                jnp.asarray(children_allowed), feature_mask)
+                jnp.asarray(children_allowed), mask_left, mask_right)
             pending = jax.device_get(rec)
         return tree, state.leaf_of_row[:self.N]

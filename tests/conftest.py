@@ -7,7 +7,10 @@ Must run before jax initializes.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force-set: the environment may pre-set JAX_PLATFORMS=axon (the TPU
+# tunnel); tests must run on the virtual CPU mesh regardless
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

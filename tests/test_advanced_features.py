@@ -1,0 +1,149 @@
+"""Monotone constraints, interaction constraints, linear trees, refit,
+binary dataset cache — the reference's advanced-capability test patterns
+(reference: test_engine.py monotone/interaction/linear_tree blocks)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def test_monotone_increasing():
+    rng = np.random.RandomState(0)
+    X = rng.randn(1000, 3)
+    y = X[:, 0] ** 3 + 0.5 * X[:, 1] + 0.05 * rng.randn(1000)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "verbosity": -1,
+                     "monotone_constraints": [1, 0, 0]}, ds,
+                    num_boost_round=30)
+    xs = np.linspace(-2.5, 2.5, 200)
+    grid = np.zeros((200, 3))
+    grid[:, 0] = xs
+    p = bst.predict(grid)
+    assert (np.diff(p) >= -1e-9).all()
+
+
+def test_monotone_decreasing():
+    rng = np.random.RandomState(1)
+    X = rng.randn(1000, 2)
+    y = -X[:, 0] + 0.2 * X[:, 1] + 0.05 * rng.randn(1000)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "verbosity": -1,
+                     "monotone_constraints": [-1, 0]}, ds,
+                    num_boost_round=20)
+    xs = np.linspace(-2.5, 2.5, 100)
+    grid = np.zeros((100, 2))
+    grid[:, 0] = xs
+    p = bst.predict(grid)
+    assert (np.diff(p) <= 1e-9).all()
+
+
+def test_interaction_constraints():
+    rng = np.random.RandomState(0)
+    X = rng.randn(800, 4)
+    y = X[:, 0] * X[:, 1] + X[:, 2] + 0.05 * rng.randn(800)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "verbosity": -1,
+                     "interaction_constraints": [[0, 1], [2, 3]]}, ds,
+                    num_boost_round=10)
+    for t in bst.inner.models:
+        def walk(node, path):
+            if node < 0:
+                return
+            newp = path | {int(t.split_feature[node])}
+            assert newp <= {0, 1} or newp <= {2, 3}, \
+                "interaction constraint violated: %s" % newp
+            walk(int(t.left_child[node]), newp)
+            walk(int(t.right_child[node]), newp)
+        if t.num_leaves > 1:
+            walk(0, set())
+
+
+def test_feature_fraction_bynode():
+    rng = np.random.RandomState(2)
+    X = rng.randn(600, 6)
+    y = X @ rng.randn(6) + 0.1 * rng.randn(600)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "verbosity": -1,
+                     "feature_fraction_bynode": 0.5}, ds,
+                    num_boost_round=10)
+    assert bst.num_trees() == 10
+
+
+def test_linear_tree():
+    rng = np.random.RandomState(3)
+    X = rng.randn(1500, 3)
+    # piecewise-linear target: linear trees should fit far better than
+    # constant leaves at equal leaf budget
+    y = np.where(X[:, 0] > 0, 2.0 * X[:, 1], -1.5 * X[:, 1]) \
+        + 0.05 * rng.randn(1500)
+    params = {"objective": "regression", "verbosity": -1,
+              "num_leaves": 4}
+    d1 = lgb.Dataset(X, label=y, params=dict(params, linear_tree=True))
+    b_lin = lgb.train(dict(params, linear_tree=True), d1,
+                      num_boost_round=10)
+    d2 = lgb.Dataset(X.copy(), label=y, params=params)
+    b_const = lgb.train(params, d2, num_boost_round=10)
+    mse_lin = np.mean((b_lin.predict(X) - y) ** 2)
+    mse_const = np.mean((b_const.predict(X) - y) ** 2)
+    assert mse_lin < 0.5 * mse_const
+
+
+def test_linear_tree_roundtrip():
+    rng = np.random.RandomState(4)
+    X = rng.randn(800, 2)
+    y = X[:, 0] * 1.5 + 0.05 * rng.randn(800)
+    params = {"objective": "regression", "verbosity": -1,
+              "linear_tree": True, "num_leaves": 4}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.train(params, ds, num_boost_round=5)
+    s = bst.model_to_string()
+    assert "is_linear=1" in s
+    b2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst.predict(X), b2.predict(X), rtol=1e-10)
+
+
+def test_refit():
+    from lightgbm_tpu.boosting.refit import refit_model
+    rng = np.random.RandomState(5)
+    X = rng.randn(800, 3)
+    y = X[:, 0] + 0.1 * rng.randn(800)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "verbosity": -1}, ds,
+                    num_boost_round=10)
+    # refit on shifted data moves predictions toward the new labels
+    y2 = y + 5.0
+    before = bst.predict(X).mean()
+    refit_model(bst.inner, X, y2, decay_rate=0.5)
+    after = bst.predict(X).mean()
+    assert after > before + 1.0
+
+
+def test_binary_dataset_cache(tmp_path):
+    from lightgbm_tpu.io.binary_io import load_binary, save_binary
+    rng = np.random.RandomState(6)
+    X = rng.randn(500, 4)
+    y = X[:, 0] + 0.1 * rng.randn(500)
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    path = str(tmp_path / "data.bin")
+    save_binary(ds.handle, path)
+    loaded = load_binary(path + ".npz")
+    np.testing.assert_array_equal(loaded.bins, ds.handle.bins)
+    np.testing.assert_array_equal(loaded.metadata.label,
+                                  ds.handle.metadata.label)
+    assert loaded.num_bin_per_feature.tolist() == \
+        ds.handle.num_bin_per_feature.tolist()
+
+
+def test_rollback_restores_scores():
+    rng = np.random.RandomState(7)
+    X = rng.randn(400, 3)
+    y = X[:, 0] + 0.1 * rng.randn(400)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "verbosity": -1}, ds,
+                    num_boost_round=5)
+    score5 = np.asarray(bst.inner.train_score).copy()
+    bst.update()
+    bst.rollback_one_iter()
+    np.testing.assert_allclose(np.asarray(bst.inner.train_score), score5,
+                               atol=1e-5)

@@ -1,0 +1,316 @@
+"""End-to-end boosting tests — the analogue of the reference's
+tests/python_package_test/test_engine.py metric-threshold pattern
+(reference: test_engine.py:62 test_binary, :116 test_regression,
+:429 test_multiclass): train a real model per objective and assert the
+final metric clears a threshold.
+"""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.boosting import create_boosting
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.metric import create_metric
+
+
+def _make_binary(n=1200, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    logit = X[:, 0] + 0.5 * X[:, 1] ** 2 - 0.7 * X[:, 2]
+    y = (logit + 0.3 * rng.randn(n) > 0.2).astype(np.float64)
+    return X, y
+
+
+def _make_regression(n=1200, f=8, seed=1):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X[:, 0] * 2.0 + np.sin(X[:, 1]) + 0.05 * rng.randn(n)
+    return X, y
+
+
+def _train(params, X, y, **data_kw):
+    cfg = Config.from_params(params)
+    ds = BinnedDataset.from_matrix(X, cfg, label=y, **data_kw)
+    booster = create_boosting(cfg, ds)
+    booster.train()
+    return booster, ds
+
+
+def _metric_value(booster, ds, name):
+    cfg = booster.config
+    m = create_metric(name, cfg)
+    m.init(ds.metadata, ds.num_data)
+    score = np.asarray(booster.train_score)
+    if booster.num_tree_per_iteration == 1:
+        score = score[:, 0]
+    return m.eval(score, booster.objective)[0]
+
+
+class TestBinary:
+    def test_binary_auc(self):
+        X, y = _make_binary()
+        booster, ds = _train({"objective": "binary", "num_iterations": 30,
+                              "num_leaves": 15, "verbosity": -1}, X, y)
+        assert _metric_value(booster, ds, "auc") > 0.98
+        assert _metric_value(booster, ds, "binary_logloss") < 0.2
+
+    def test_predict_probability_range(self):
+        X, y = _make_binary()
+        booster, _ = _train({"objective": "binary", "num_iterations": 10,
+                             "verbosity": -1}, X, y)
+        p = booster.predict(X)
+        assert p.min() >= 0.0 and p.max() <= 1.0
+        assert ((p > 0.5) == (y > 0)).mean() > 0.9
+
+    def test_model_roundtrip(self):
+        X, y = _make_binary()
+        booster, _ = _train({"objective": "binary", "num_iterations": 8,
+                             "verbosity": -1}, X, y)
+        s = booster.save_model_to_string()
+        b2 = create_boosting(booster.config)
+        b2.load_model_from_string(s)
+        np.testing.assert_allclose(booster.predict(X), b2.predict(X),
+                                   rtol=1e-12)
+
+    def test_is_unbalance(self):
+        X, y = _make_binary()
+        booster, ds = _train({"objective": "binary", "is_unbalance": True,
+                              "num_iterations": 15, "verbosity": -1}, X, y)
+        assert _metric_value(booster, ds, "auc") > 0.95
+
+    def test_weights(self):
+        X, y = _make_binary()
+        w = np.abs(np.random.RandomState(3).randn(len(y))) + 0.1
+        booster, ds = _train({"objective": "binary", "num_iterations": 15,
+                              "verbosity": -1}, X, y, weights=w)
+        assert _metric_value(booster, ds, "auc") > 0.95
+
+
+class TestRegression:
+    def test_l2(self):
+        X, y = _make_regression()
+        booster, ds = _train({"objective": "regression",
+                              "num_iterations": 50, "num_leaves": 31,
+                              "verbosity": -1}, X, y)
+        assert _metric_value(booster, ds, "l2") < 0.1 * np.var(y)
+
+    def test_l1(self):
+        X, y = _make_regression()
+        booster, ds = _train({"objective": "regression_l1",
+                              "num_iterations": 50, "verbosity": -1}, X, y)
+        base = np.abs(y - np.median(y)).mean()
+        assert _metric_value(booster, ds, "l1") < 0.4 * base
+
+    def test_huber(self):
+        X, y = _make_regression()
+        booster, ds = _train({"objective": "huber", "num_iterations": 50,
+                              "verbosity": -1}, X, y)
+        assert _metric_value(booster, ds, "l2") < 0.3 * np.var(y)
+
+    def test_quantile(self):
+        X, y = _make_regression()
+        booster, ds = _train({"objective": "quantile", "alpha": 0.7,
+                              "num_iterations": 40, "verbosity": -1}, X, y)
+        pred = booster.predict(X)
+        # ~70% of residuals should be below the prediction
+        frac_below = (y <= pred).mean()
+        assert 0.55 < frac_below < 0.85
+
+    def test_poisson(self):
+        rng = np.random.RandomState(5)
+        X = rng.randn(1000, 5)
+        lam = np.exp(0.5 * X[:, 0] + 0.2 * X[:, 1])
+        y = rng.poisson(lam).astype(np.float64)
+        booster, ds = _train({"objective": "poisson", "num_iterations": 40,
+                              "verbosity": -1}, X, y)
+        pred = booster.predict(X)
+        assert pred.min() > 0  # exp link
+        assert np.corrcoef(pred, lam)[0, 1] > 0.8
+
+    def test_gamma(self):
+        rng = np.random.RandomState(6)
+        X = rng.randn(1000, 5)
+        mu = np.exp(0.4 * X[:, 0])
+        y = rng.gamma(2.0, mu / 2.0) + 1e-3
+        booster, _ = _train({"objective": "gamma", "num_iterations": 40,
+                             "verbosity": -1}, X, y)
+        pred = booster.predict(X)
+        assert np.corrcoef(pred, mu)[0, 1] > 0.7
+
+    def test_tweedie(self):
+        rng = np.random.RandomState(7)
+        X = rng.randn(1000, 5)
+        mu = np.exp(0.4 * X[:, 0])
+        y = np.where(rng.rand(1000) < 0.3, 0.0, rng.gamma(2.0, mu))
+        booster, _ = _train({"objective": "tweedie", "num_iterations": 40,
+                             "verbosity": -1}, X, y)
+        pred = booster.predict(X)
+        assert pred.min() > 0
+
+    def test_mape(self):
+        X, y = _make_regression()
+        y = y + 10.0  # keep |label| > 1
+        booster, ds = _train({"objective": "mape", "num_iterations": 40,
+                              "verbosity": -1}, X, y)
+        assert _metric_value(booster, ds, "mape") < 0.05
+
+    def test_fair(self):
+        X, y = _make_regression()
+        booster, ds = _train({"objective": "fair", "num_iterations": 50,
+                              "verbosity": -1}, X, y)
+        assert _metric_value(booster, ds, "l2") < 0.4 * np.var(y)
+
+    def test_reg_sqrt(self):
+        X, y = _make_regression()
+        y = y ** 2 * np.sign(y)
+        booster, _ = _train({"objective": "regression", "reg_sqrt": True,
+                             "num_iterations": 40, "verbosity": -1}, X, y)
+        pred = booster.predict(X)
+        assert np.corrcoef(pred, y)[0, 1] > 0.9
+
+
+class TestMulticlass:
+    def _make(self, n=1500, seed=2):
+        rng = np.random.RandomState(seed)
+        X = rng.randn(n, 6)
+        y = np.argmax(X[:, :3] + 0.3 * rng.randn(n, 3), axis=1).astype(
+            np.float64)
+        return X, y
+
+    def test_softmax(self):
+        X, y = self._make()
+        booster, ds = _train({"objective": "multiclass", "num_class": 3,
+                              "num_iterations": 30, "verbosity": -1}, X, y)
+        assert _metric_value(booster, ds, "multi_logloss") < 0.4
+        p = booster.predict(X)
+        assert p.shape == (len(y), 3)
+        np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
+        assert (np.argmax(p, axis=1) == y).mean() > 0.85
+
+    def test_ova(self):
+        X, y = self._make()
+        booster, ds = _train({"objective": "multiclassova", "num_class": 3,
+                              "num_iterations": 30, "verbosity": -1}, X, y)
+        p = booster.predict(X)
+        assert (np.argmax(p, axis=1) == y).mean() > 0.85
+
+    def test_multiclass_roundtrip(self):
+        X, y = self._make()
+        booster, _ = _train({"objective": "multiclass", "num_class": 3,
+                             "num_iterations": 5, "verbosity": -1}, X, y)
+        s = booster.save_model_to_string()
+        b2 = create_boosting(booster.config)
+        b2.load_model_from_string(s)
+        np.testing.assert_allclose(booster.predict_raw(X),
+                                   b2.predict_raw(X), rtol=1e-12)
+
+
+class TestXentropy:
+    def test_cross_entropy(self):
+        rng = np.random.RandomState(4)
+        X = rng.randn(1000, 5)
+        p_true = 1.0 / (1.0 + np.exp(-(X[:, 0] - 0.5 * X[:, 1])))
+        y = np.clip(p_true + 0.05 * rng.randn(1000), 0, 1)
+        booster, ds = _train({"objective": "cross_entropy",
+                              "num_iterations": 40, "verbosity": -1}, X, y)
+        pred = booster.predict(X)
+        assert np.corrcoef(pred, p_true)[0, 1] > 0.9
+
+
+class TestSampling:
+    def test_bagging(self):
+        X, y = _make_binary()
+        booster, ds = _train({"objective": "binary", "num_iterations": 30,
+                              "bagging_fraction": 0.6, "bagging_freq": 2,
+                              "verbosity": -1}, X, y)
+        assert _metric_value(booster, ds, "auc") > 0.97
+
+    def test_goss(self):
+        X, y = _make_binary()
+        booster, ds = _train({"objective": "binary", "num_iterations": 30,
+                              "data_sample_strategy": "goss",
+                              "verbosity": -1}, X, y)
+        assert _metric_value(booster, ds, "auc") > 0.97
+
+    def test_feature_fraction(self):
+        X, y = _make_binary()
+        booster, ds = _train({"objective": "binary", "num_iterations": 30,
+                              "feature_fraction": 0.5, "verbosity": -1},
+                             X, y)
+        assert _metric_value(booster, ds, "auc") > 0.95
+
+
+class TestBoostingVariants:
+    def test_dart(self):
+        X, y = _make_binary()
+        booster, ds = _train({"objective": "binary", "boosting": "dart",
+                              "num_iterations": 25, "drop_rate": 0.2,
+                              "verbosity": -1}, X, y)
+        assert _metric_value(booster, ds, "auc") > 0.95
+
+    def test_rf(self):
+        X, y = _make_binary()
+        booster, ds = _train({"objective": "binary", "boosting": "rf",
+                              "bagging_fraction": 0.7, "bagging_freq": 1,
+                              "num_iterations": 20, "num_leaves": 31,
+                              "verbosity": -1}, X, y)
+        assert _metric_value(booster, ds, "auc") > 0.95
+
+
+class TestEarlyStoppingAndValid:
+    def test_valid_early_stop(self):
+        X, y = _make_binary(n=2000)
+        Xv, yv = _make_binary(n=500, seed=9)
+        cfg = Config.from_params({
+            "objective": "binary", "num_iterations": 200,
+            "early_stopping_round": 5, "metric": "binary_logloss",
+            "verbosity": -1})
+        ds = BinnedDataset.from_matrix(X, cfg, label=y)
+        vs = BinnedDataset.from_matrix(Xv, cfg, label=yv, reference=ds)
+        booster = create_boosting(cfg, ds)
+        booster.add_valid_data(vs)
+        booster.train()
+        # stopped before the full 200 iterations
+        assert booster.current_iteration < 200
+        assert booster.best_iteration > 0
+
+    def test_rollback(self):
+        X, y = _make_binary()
+        booster, ds = _train({"objective": "binary", "num_iterations": 10,
+                              "verbosity": -1}, X, y)
+        n_models = len(booster.models)
+        score_before = np.asarray(booster.train_score).copy()
+        booster.rollback_one_iter()
+        assert len(booster.models) == n_models - 1
+        assert not np.allclose(np.asarray(booster.train_score),
+                               score_before)
+
+
+class TestRanking:
+    def _make_ranking(self, nq=60, docs=12, seed=11):
+        rng = np.random.RandomState(seed)
+        n = nq * docs
+        X = rng.randn(n, 6)
+        rel = np.clip((X[:, 0] + 0.5 * X[:, 1]
+                       + 0.3 * rng.randn(n)) * 1.2 + 1.2, 0, 4)
+        y = np.floor(rel).astype(np.float64)
+        group = np.full(nq, docs)
+        return X, y, group
+
+    def test_lambdarank(self):
+        X, y, group = self._make_ranking()
+        booster, ds = _train({"objective": "lambdarank",
+                              "num_iterations": 30, "num_leaves": 15,
+                              "min_data_in_leaf": 5, "eval_at": [3],
+                              "verbosity": -1}, X, y, group=group)
+        ndcg = _metric_value(booster, ds, "ndcg")
+        assert ndcg > 0.80
+
+    def test_rank_xendcg(self):
+        X, y, group = self._make_ranking()
+        booster, ds = _train({"objective": "rank_xendcg",
+                              "num_iterations": 30, "num_leaves": 15,
+                              "min_data_in_leaf": 5, "eval_at": [3],
+                              "verbosity": -1}, X, y, group=group)
+        ndcg = _metric_value(booster, ds, "ndcg")
+        assert ndcg > 0.75

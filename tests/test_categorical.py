@@ -1,0 +1,98 @@
+"""Categorical feature tests — the analogue of the reference's
+test_engine.py categorical handling block (reference:
+tests/python_package_test/test_engine.py:309-389)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _cat_data(n=2000, n_cats=10, seed=0):
+    rng = np.random.RandomState(seed)
+    cat = rng.randint(0, n_cats, n).astype(np.float64)
+    x1 = rng.randn(n)
+    effect = rng.randn(n_cats) * 2.0
+    y = effect[cat.astype(int)] + 0.3 * x1 + 0.1 * rng.randn(n)
+    X = np.column_stack([cat, x1])
+    return X, y, effect
+
+
+class TestCategorical:
+    def test_learns_nonmonotone_effects(self):
+        X, y, _ = _cat_data()
+        ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+        bst = lgb.train({"objective": "regression", "verbosity": -1,
+                         "min_data_in_leaf": 20}, ds, num_boost_round=30)
+        mse = np.mean((bst.predict(X) - y) ** 2)
+        assert mse < 0.1 * np.var(y)
+
+    def test_vs_numerical_treatment(self):
+        # treating a shuffled-effect categorical as numerical needs far
+        # more splits; categorical should fit better at equal budget
+        X, y, _ = _cat_data(n_cats=20, seed=3)
+        params = {"objective": "regression", "verbosity": -1,
+                  "num_leaves": 8, "min_data_in_leaf": 20}
+        d_cat = lgb.Dataset(X, label=y, categorical_feature=[0])
+        d_num = lgb.Dataset(X.copy(), label=y)
+        b_cat = lgb.train(params, d_cat, num_boost_round=10)
+        b_num = lgb.train(params, d_num, num_boost_round=10)
+        mse_cat = np.mean((b_cat.predict(X) - y) ** 2)
+        mse_num = np.mean((b_num.predict(X) - y) ** 2)
+        assert mse_cat < mse_num
+
+    def test_model_roundtrip(self):
+        X, y, _ = _cat_data()
+        ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+        bst = lgb.train({"objective": "regression", "verbosity": -1},
+                        ds, num_boost_round=10)
+        b2 = lgb.Booster(model_str=bst.model_to_string())
+        np.testing.assert_allclose(bst.predict(X), b2.predict(X),
+                                   rtol=1e-12)
+        assert "cat_threshold=" in bst.model_to_string()
+
+    def test_unseen_category_goes_right(self):
+        X, y, _ = _cat_data()
+        ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+        bst = lgb.train({"objective": "regression", "verbosity": -1},
+                        ds, num_boost_round=10)
+        X_unseen = X.copy()
+        X_unseen[:, 0] = 999  # category never seen in training
+        p = bst.predict(X_unseen)
+        assert np.isfinite(p).all()
+
+    def test_nan_category(self):
+        X, y, _ = _cat_data()
+        X_nan = X.copy()
+        X_nan[::7, 0] = np.nan
+        ds = lgb.Dataset(X_nan, label=y, categorical_feature=[0])
+        bst = lgb.train({"objective": "regression", "verbosity": -1},
+                        ds, num_boost_round=10)
+        p = bst.predict(X_nan)
+        assert np.isfinite(p).all()
+
+    def test_onehot_mode_small_cardinality(self):
+        # <= max_cat_to_onehot (4) categories → one-hot path
+        X, y, _ = _cat_data(n_cats=3, seed=5)
+        ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+        bst = lgb.train({"objective": "regression", "verbosity": -1,
+                         "min_data_in_leaf": 20}, ds, num_boost_round=20)
+        mse = np.mean((bst.predict(X) - y) ** 2)
+        assert mse < 0.2 * np.var(y)
+
+    def test_binary_with_categoricals(self):
+        rng = np.random.RandomState(7)
+        n = 1500
+        cat = rng.randint(0, 8, n).astype(np.float64)
+        pos_rate = np.array([0.9, 0.1, 0.8, 0.2, 0.7, 0.3, 0.95, 0.05])
+        y = (rng.rand(n) < pos_rate[cat.astype(int)]).astype(np.float64)
+        X = np.column_stack([cat, rng.randn(n)])
+        ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+        bst = lgb.train({"objective": "binary", "verbosity": -1,
+                         "min_data_in_leaf": 20}, ds, num_boost_round=20)
+        from lightgbm_tpu.metric import create_metric
+        from lightgbm_tpu.config import Config
+        m = create_metric("auc", Config.from_params({}))
+        m.init(ds.handle.metadata, n)
+        auc = m.eval(np.asarray(bst.inner.train_score[:, 0]),
+                     bst.inner.objective)[0]
+        assert auc > 0.75

@@ -1,0 +1,166 @@
+"""Metric unit tests — values checked against closed forms / sklearn
+(the reference covers metrics through test_engine.py e2e assertions;
+here we also pin the formulas directly)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import Metadata
+from lightgbm_tpu.metric import create_metric
+
+
+def _metric(name, label, score, weights=None, params=None, group=None):
+    cfg = Config.from_params(params or {})
+    m = create_metric(name, cfg)
+    md = Metadata(len(label))
+    md.set_label(label)
+    md.set_weights(weights)
+    md.set_group(group)
+    m.init(md, len(label))
+    return m.eval(np.asarray(score, dtype=np.float64))
+
+
+def test_l2():
+    y = np.array([1.0, 2.0, 3.0])
+    s = np.array([1.5, 2.0, 2.0])
+    assert np.isclose(_metric("l2", y, s)[0], (0.25 + 0 + 1.0) / 3)
+
+
+def test_rmse():
+    y = np.array([0.0, 0.0])
+    s = np.array([3.0, 4.0])
+    assert np.isclose(_metric("rmse", y, s)[0], np.sqrt(12.5))
+
+
+def test_l1_weighted():
+    y = np.array([1.0, 2.0])
+    s = np.array([2.0, 0.0])
+    w = np.array([1.0, 3.0])
+    assert np.isclose(_metric("l1", y, s, weights=w)[0],
+                      (1.0 * 1 + 2.0 * 3) / 4.0)
+
+
+def test_auc_perfect_and_inverted():
+    y = np.array([0, 0, 1, 1], dtype=float)
+    assert np.isclose(_metric("auc", y, [0.1, 0.2, 0.8, 0.9])[0], 1.0)
+    assert np.isclose(_metric("auc", y, [0.9, 0.8, 0.2, 0.1])[0], 0.0)
+    assert np.isclose(_metric("auc", y, [0.5, 0.5, 0.5, 0.5])[0], 0.5)
+
+
+def test_auc_against_sklearn():
+    rng = np.random.RandomState(0)
+    y = (rng.rand(500) > 0.4).astype(float)
+    s = rng.randn(500) + y
+    from sklearn.metrics import roc_auc_score
+    assert np.isclose(_metric("auc", y, s)[0], roc_auc_score(y, s))
+
+
+def test_weighted_auc_against_sklearn():
+    rng = np.random.RandomState(1)
+    y = (rng.rand(300) > 0.5).astype(float)
+    s = rng.randn(300) + 0.5 * y
+    w = rng.rand(300) + 0.1
+    from sklearn.metrics import roc_auc_score
+    assert np.isclose(_metric("auc", y, s, weights=w)[0],
+                      roc_auc_score(y, s, sample_weight=w), atol=1e-9)
+
+
+def test_binary_logloss():
+    y = np.array([1.0, 0.0])
+    # raw scores; metric applies sigmoid
+    s = np.array([0.0, 0.0])
+    assert np.isclose(_metric("binary_logloss", y, s)[0], np.log(2.0))
+
+
+def test_binary_error():
+    y = np.array([1.0, 1.0, 0.0, 0.0])
+    s = np.array([1.0, -1.0, -1.0, 1.0])  # sigmoid > .5 iff s > 0
+    assert np.isclose(_metric("binary_error", y, s)[0], 0.5)
+
+
+def test_multi_logloss():
+    y = np.array([0.0, 1.0])
+    score = np.array([[2.0, 0.0, 0.0], [0.0, 2.0, 0.0]])
+    p = np.exp(2.0) / (np.exp(2.0) + 2.0)
+    expected = -np.log(p)
+    got = _metric("multi_logloss", y, score, params={
+        "objective": "multiclass", "num_class": 3})[0]
+    assert np.isclose(got, expected, rtol=1e-6)
+
+
+def test_multi_error_topk():
+    y = np.array([0.0, 1.0])
+    score = np.array([[0.5, 0.3, 0.2], [0.5, 0.3, 0.2]])
+    assert np.isclose(_metric("multi_error", y, score, params={
+        "objective": "multiclass", "num_class": 3})[0], 0.5)
+    assert np.isclose(_metric("multi_error", y, score, params={
+        "objective": "multiclass", "num_class": 3,
+        "multi_error_top_k": 2})[0], 0.0)
+
+
+def test_ndcg_perfect():
+    y = np.array([3.0, 2.0, 1.0, 0.0])
+    s = np.array([4.0, 3.0, 2.0, 1.0])
+    got = _metric("ndcg", y, s, params={"eval_at": [4]}, group=[4])
+    assert np.isclose(got[0], 1.0)
+
+
+def test_ndcg_value():
+    y = np.array([0.0, 1.0])
+    s = np.array([1.0, 0.0])  # worse doc ranked first
+    # DCG = 0/log2(2) + 1/log2(3); maxDCG = 1/log2(2)
+    expected = (1.0 / np.log2(3)) / 1.0
+    got = _metric("ndcg", y, s, params={"eval_at": [2]}, group=[2])
+    assert np.isclose(got[0], expected)
+
+
+def test_map():
+    y = np.array([1.0, 0.0, 1.0, 0.0])
+    s = np.array([4.0, 3.0, 2.0, 1.0])
+    # AP@4 = (1/1 + 2/3)/2
+    got = _metric("map", y, s, params={"eval_at": [4]}, group=[4])
+    assert np.isclose(got[0], (1.0 + 2.0 / 3.0) / 2.0)
+
+
+def test_average_precision_against_sklearn():
+    rng = np.random.RandomState(2)
+    y = (rng.rand(400) > 0.6).astype(float)
+    s = rng.randn(400) + y
+    from sklearn.metrics import average_precision_score
+    assert np.isclose(_metric("average_precision", y, s)[0],
+                      average_precision_score(y, s), atol=1e-6)
+
+
+def test_cross_entropy():
+    y = np.array([0.3, 0.7])
+    s = np.array([0.0, 0.0])
+    assert np.isclose(_metric("cross_entropy", y, s)[0], np.log(2.0))
+
+
+def test_kldiv_zero_at_perfect():
+    y = np.array([0.3, 0.8])
+    s = np.log(y / (1 - y))
+    assert abs(_metric("kullback_leibler", y, s)[0]) < 1e-6
+
+
+def test_quantile_metric():
+    y = np.array([1.0, 1.0])
+    s = np.array([0.0, 2.0])
+    # alpha=0.9: (0.9*1 + 0.1*1)/2
+    got = _metric("quantile", y, s, params={"alpha": 0.9})[0]
+    assert np.isclose(got, 0.5)
+
+
+def test_gamma_deviance():
+    y = np.array([1.0, 2.0])
+    s = np.array([1.0, 2.0])
+    assert abs(_metric("gamma_deviance", y, s)[0]) < 1e-6
+
+
+def test_auc_mu_binary_case():
+    # with 2 classes auc_mu reduces to standard AUC on the score diff
+    y = np.array([0.0, 0.0, 1.0, 1.0])
+    score = np.array([[0.9, 0.1], [0.8, 0.2], [0.2, 0.8], [0.1, 0.9]])
+    got = _metric("auc_mu", y, score, params={
+        "objective": "multiclass", "num_class": 2})[0]
+    assert np.isclose(got, 1.0)

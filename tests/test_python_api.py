@@ -1,0 +1,219 @@
+"""Python API surface tests (Dataset/Booster/train/cv/callbacks/sklearn) —
+the analogue of the reference's tests/python_package_test/test_basic.py,
+test_engine.py callback sections, and test_sklearn.py."""
+import pickle
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _binary_data(n=1200, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 - 0.7 * X[:, 2]
+         + 0.3 * rng.randn(n) > 0.2).astype(np.float64)
+    return X, y
+
+
+def _reg_data(n=1200, seed=1):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 8)
+    y = X[:, 0] * 2.0 + np.sin(X[:, 1]) + 0.05 * rng.randn(n)
+    return X, y
+
+
+class TestDataset:
+    def test_lazy_construct(self):
+        X, y = _binary_data()
+        ds = lgb.Dataset(X, label=y)
+        assert ds._handle is None
+        ds.construct()
+        assert ds._handle is not None
+        assert ds.num_data() == len(y)
+        assert ds.num_feature() == X.shape[1]
+
+    def test_subset(self):
+        X, y = _binary_data()
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        ds.construct()
+        sub = ds.subset(np.arange(100))
+        assert sub.num_data() == 100
+        np.testing.assert_array_equal(sub.get_label(), y[:100])
+
+    def test_feature_names(self):
+        X, y = _binary_data()
+        names = ["f%d" % i for i in range(X.shape[1])]
+        ds = lgb.Dataset(X, label=y, feature_name=names)
+        assert ds.get_feature_name() == names
+
+
+class TestTrain:
+    def test_train_and_early_stopping(self):
+        X, y = _binary_data(2000)
+        Xv, yv = _binary_data(500, seed=7)
+        ds = lgb.Dataset(X, label=y)
+        vs = lgb.Dataset(Xv, label=yv, reference=ds)
+        evals = {}
+        bst = lgb.train(
+            {"objective": "binary", "metric": "binary_logloss",
+             "verbosity": -1},
+            ds, num_boost_round=100, valid_sets=[vs],
+            callbacks=[lgb.early_stopping(5, verbose=False),
+                       lgb.record_evaluation(evals)])
+        assert bst.best_iteration > 0
+        assert len(evals["valid_0"]["binary_logloss"]) \
+            == bst.current_iteration
+        # predictions use the best iteration by default
+        p = bst.predict(Xv)
+        assert ((p > 0.5) == (yv > 0)).mean() > 0.9
+
+    def test_custom_fobj_feval(self):
+        X, y = _reg_data()
+        ds = lgb.Dataset(X, label=y)
+
+        def l2_obj(score, dataset):
+            label = dataset.get_label() if dataset is not None else y
+            return score - y, np.ones_like(score)
+
+        def mae_feval(score, dataset):
+            return "mae", float(np.abs(score - y).mean()), False
+
+        params = {"objective": l2_obj, "metric": "none", "verbosity": -1}
+        bst = lgb.train(params, ds, num_boost_round=30)
+        pred = bst.predict(X, raw_score=True)
+        assert np.abs(pred - y).mean() < 0.5
+
+    def test_reset_parameter_callback(self):
+        X, y = _reg_data()
+        ds = lgb.Dataset(X, label=y)
+        lrs = []
+
+        class Spy:
+            def __call__(self, env):
+                lrs.append(env.model)
+        bst = lgb.train(
+            {"objective": "regression", "verbosity": -1},
+            ds, num_boost_round=5,
+            callbacks=[lgb.reset_parameter(
+                learning_rate=[0.1, 0.09, 0.08, 0.07, 0.06])])
+        assert bst.current_iteration == 5
+
+    def test_continue_training(self):
+        X, y = _reg_data()
+        ds = lgb.Dataset(X, label=y)
+        bst1 = lgb.train({"objective": "regression", "verbosity": -1},
+                         ds, num_boost_round=10)
+        ds2 = lgb.Dataset(X, label=y)
+        bst2 = lgb.train({"objective": "regression", "verbosity": -1},
+                         ds2, num_boost_round=10, init_model=bst1)
+        assert bst2.num_trees() == 20
+        mse1 = float(np.mean((bst1.predict(X) - y) ** 2))
+        mse2 = float(np.mean((bst2.predict(X) - y) ** 2))
+        assert mse2 < mse1
+
+    def test_model_file_roundtrip(self, tmp_path):
+        X, y = _binary_data()
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "binary", "verbosity": -1}, ds,
+                        num_boost_round=8)
+        path = str(tmp_path / "model.txt")
+        bst.save_model(path)
+        bst2 = lgb.Booster(model_file=path)
+        np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                                   rtol=1e-12)
+
+
+class TestCV:
+    def test_cv_regression(self):
+        X, y = _reg_data()
+        ds = lgb.Dataset(X, label=y)
+        res = lgb.cv({"objective": "regression", "metric": "l2",
+                      "verbosity": -1}, ds, num_boost_round=10, nfold=3)
+        assert "valid l2-mean" in res
+        assert len(res["valid l2-mean"]) == 10
+        # loss decreases over iterations
+        assert res["valid l2-mean"][-1] < res["valid l2-mean"][0]
+
+    def test_cv_stratified_binary(self):
+        X, y = _binary_data()
+        ds = lgb.Dataset(X, label=y)
+        res = lgb.cv({"objective": "binary", "metric": "auc",
+                      "verbosity": -1}, ds, num_boost_round=5, nfold=3,
+                     stratified=True)
+        assert res["valid auc-mean"][-1] > 0.9
+
+
+class TestSklearn:
+    def test_regressor(self):
+        X, y = _reg_data()
+        model = lgb.LGBMRegressor(n_estimators=30, verbosity=-1)
+        model.fit(X, y)
+        pred = model.predict(X)
+        assert np.mean((pred - y) ** 2) < 0.2 * np.var(y)
+        assert model.feature_importances_.sum() > 0
+
+    def test_classifier_binary(self):
+        X, y = _binary_data()
+        model = lgb.LGBMClassifier(n_estimators=30, verbosity=-1)
+        model.fit(X, y)
+        assert (model.predict(X) == y).mean() > 0.9
+        proba = model.predict_proba(X)
+        assert proba.shape == (len(y), 2)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+
+    def test_classifier_multiclass(self):
+        rng = np.random.RandomState(3)
+        X = rng.randn(900, 6)
+        y = np.argmax(X[:, :3], axis=1)
+        model = lgb.LGBMClassifier(n_estimators=20, verbosity=-1)
+        model.fit(X, y)
+        assert model.n_classes_ == 3
+        assert (model.predict(X) == y).mean() > 0.85
+
+    def test_classifier_string_labels(self):
+        X, y = _binary_data()
+        labels = np.where(y > 0, "yes", "no")
+        model = lgb.LGBMClassifier(n_estimators=10, verbosity=-1)
+        model.fit(X, labels)
+        pred = model.predict(X)
+        assert set(np.unique(pred)) <= {"yes", "no"}
+        assert (pred == labels).mean() > 0.9
+
+    def test_ranker(self):
+        rng = np.random.RandomState(5)
+        nq, docs = 40, 10
+        X = rng.randn(nq * docs, 5)
+        y = np.clip((X[:, 0] * 2 + rng.randn(nq * docs) * 0.3) + 2,
+                    0, 4).astype(int)
+        group = np.full(nq, docs)
+        model = lgb.LGBMRanker(n_estimators=20, verbosity=-1,
+                               min_child_samples=5)
+        model.fit(X, y, group=group)
+        pred = model.predict(X)
+        assert np.corrcoef(pred, y)[0, 1] > 0.5
+
+    def test_eval_set(self):
+        X, y = _binary_data()
+        Xv, yv = _binary_data(300, seed=9)
+        model = lgb.LGBMClassifier(n_estimators=30, verbosity=-1)
+        model.fit(X, y, eval_set=[(Xv, yv)], eval_metric="binary_logloss",
+                  callbacks=[lgb.early_stopping(5, verbose=False)])
+        assert model.best_iteration_ > 0
+        assert "valid_0" in model.evals_result_
+
+    def test_get_set_params(self):
+        model = lgb.LGBMRegressor(n_estimators=5, num_leaves=7)
+        params = model.get_params()
+        assert params["num_leaves"] == 7
+        model.set_params(num_leaves=15)
+        assert model.num_leaves == 15
+
+    def test_sklearn_pickle(self):
+        X, y = _reg_data()
+        model = lgb.LGBMRegressor(n_estimators=10, verbosity=-1)
+        model.fit(X, y)
+        m2 = pickle.loads(pickle.dumps(model))
+        np.testing.assert_allclose(model.predict(X), m2.predict(X),
+                                   rtol=1e-12)

@@ -1,0 +1,47 @@
+"""SHAP contribution tests (reference: test_engine.py:1408
+test_contribs — additivity of predict_contrib against raw predictions)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def test_contrib_additivity_regression():
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 5)
+    y = 2 * X[:, 0] + X[:, 1] + 0.01 * rng.randn(300)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "verbosity": -1}, ds,
+                    num_boost_round=20)
+    contrib = bst.predict(X, pred_contrib=True)
+    pred = bst.predict(X)
+    assert contrib.shape == (300, 6)
+    np.testing.assert_allclose(contrib.sum(axis=1), pred, atol=1e-9)
+    # dominant feature gets the largest attributions
+    mean_abs = np.abs(contrib[:, :5]).mean(axis=0)
+    assert mean_abs[0] == mean_abs.max()
+
+
+def test_contrib_additivity_binary():
+    rng = np.random.RandomState(1)
+    X = rng.randn(400, 4)
+    y = (X[:, 0] - X[:, 1] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "verbosity": -1}, ds,
+                    num_boost_round=15)
+    contrib = bst.predict(X, pred_contrib=True)
+    raw = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, atol=1e-9)
+
+
+def test_contrib_multiclass_shape():
+    rng = np.random.RandomState(2)
+    X = rng.randn(300, 4)
+    y = np.argmax(X[:, :3], axis=1).astype(float)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "verbosity": -1}, ds, num_boost_round=5)
+    contrib = bst.predict(X, pred_contrib=True)
+    assert contrib.shape == (300, 3 * 5)
+    raw = bst.predict(X, raw_score=True)
+    per_class = contrib.reshape(300, 3, 5)
+    np.testing.assert_allclose(per_class.sum(axis=2), raw, atol=1e-9)

@@ -17,9 +17,12 @@ from lightgbm_tpu.ops.split import (FeatureMeta, SplitParams, SplitInfo,
 def make_params(**kw):
     d = dict(lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=1.0,
              min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0,
-             max_delta_step=0.0)
+             max_delta_step=0.0, cat_l2=10.0, cat_smooth=10.0,
+             min_data_per_group=100.0)
     d.update(kw)
-    return SplitParams(**{k: jnp.float32(v) for k, v in d.items()})
+    out = {k: jnp.float32(v) for k, v in d.items()}
+    out["max_cat_threshold"] = jnp.int32(kw.get("max_cat_threshold", 32))
+    return SplitParams(**out)
 
 
 def oracle_best(hist, totals, meta, p, feature_mask=None):
@@ -85,7 +88,10 @@ def rand_case(rng, F=5, B=16, missing=None):
         mt[:] = missing
     meta = FeatureMeta(num_bin=jnp.asarray(num_bin),
                        missing_type=jnp.asarray(mt),
-                       zero_bin=jnp.zeros(F, dtype=jnp.int32))
+                       zero_bin=jnp.zeros(F, dtype=jnp.int32),
+                       is_categorical=jnp.zeros(F, dtype=bool),
+                       use_onehot=jnp.zeros(F, dtype=bool),
+                       monotone=jnp.zeros(F, dtype=jnp.int8))
     totals = (float(hist[0, :, 0].sum()), float(hist[0, :, 1].sum()),
               float(hist[0, :, 2].sum()))
     # make every feature's hist consistent with the same totals
@@ -163,6 +169,9 @@ def test_no_valid_split():
     hist = np.zeros((2, 4, 4), dtype=np.float32)
     hist[:, 0] = [1.0, 2.0, 10, 10]
     meta = FeatureMeta(num_bin=jnp.asarray([1, 1], dtype=jnp.int32),
+                       is_categorical=jnp.zeros(2, dtype=bool),
+                       use_onehot=jnp.zeros(2, dtype=bool),
+                       monotone=jnp.zeros(2, dtype=jnp.int8),
                        missing_type=jnp.zeros(2, dtype=jnp.int32),
                        zero_bin=jnp.zeros(2, dtype=jnp.int32))
     info = find_best_split(jnp.asarray(hist), jnp.float32(1.0),
