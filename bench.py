@@ -109,6 +109,16 @@ def run_bench() -> dict:
 
     import jax
 
+    # persistent compile cache: the learner compiles ~log2(N) bucket
+    # variants; cache them across bench runs (and across warmup/measure)
+    try:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
     platform = jax.devices()[0].platform
 
     from lightgbm_tpu.config import Config

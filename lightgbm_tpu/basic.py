@@ -71,7 +71,12 @@ class Dataset:
         if self.reference is not None:
             self.reference.construct()
         config = Config.from_params(self.params)
-        data = _to_2d_float(self.data)
+        if hasattr(self.data, "tocsc") and not config.linear_tree:
+            # scipy sparse stays sparse until binning (per-column pass +
+            # EFB in BinnedDataset.from_matrix); no densification
+            data = self.data
+        else:
+            data = _to_2d_float(self.data)
         feature_names = None
         if isinstance(self.feature_name, (list, tuple)):
             feature_names = list(self.feature_name)
@@ -174,7 +179,8 @@ class Dataset:
         import copy
         h = BinnedDataset()
         src = self._handle
-        h.bins = src.bins[idx]
+        h.bins = src.bins[idx]  # row subset keeps the bundle layout
+        h.bundle = src.bundle
         h.bin_mappers = src.bin_mappers
         h.used_feature_map = src.used_feature_map
         h.num_total_features = src.num_total_features

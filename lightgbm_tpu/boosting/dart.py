@@ -56,20 +56,17 @@ class DART(GBDT):
 
     def _apply_trees(self, iters: List[int], sign: float) -> None:
         """Add (+1) or remove (-1) the given iterations' trees from all
-        scores via host binned traversal."""
+        scores via the device binned traversal (ops/predict.py)."""
         K = self.num_tree_per_iteration
         for it in iters:
             for k in range(K):
                 tree = self.models[it * K + k]
-                leaf = tree.predict_by_bin(self.train_data.bins,
-                                           *self._bin_meta)
-                delta = (sign * tree.leaf_value[leaf]).astype(np.float32)
-                self.train_score = self.train_score.at[:, k].add(
-                    jnp.asarray(delta))
+                delta = self._tree_outputs_train(tree)
+                if delta is not None:
+                    self.train_score = self.train_score.at[:, k].add(
+                        jnp.float32(sign) * delta)
                 for vd in self.valid_data:
-                    vleaf = tree.predict_by_bin(vd.dataset.bins,
-                                                *self._bin_meta)
-                    vd.scores[:, k] += sign * tree.leaf_value[vleaf]
+                    vd.add_tree(tree, k, self._bin_meta, sign=sign)
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
         self._select_dropping_trees()

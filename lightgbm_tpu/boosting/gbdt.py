@@ -35,32 +35,80 @@ kEpsilon = 1e-15
 _K_MIN_SCORE = -np.inf
 
 
+def _device_tree_outputs(tree: Tree, bins_dev, dataset: BinnedDataset,
+                         bin_meta):
+    """Device [n] f32 per-row output of one tree over the dataset's
+    binned rows via the vectorized traversal (ops/predict.py);
+    linear-leaf trees fall back to host raw-feature prediction. Returns
+    None for zero-valued stumps. Shared by train-side (DART/rollback) and
+    valid-side scoring."""
+    if tree.is_linear and dataset.raw_data is not None:
+        from ..models.linear import linear_predict
+        leaf = tree.predict_by_bin(dataset.feature_bins(), *bin_meta)
+        return jnp.asarray(linear_predict(
+            tree, dataset.raw_data, leaf).astype(np.float32))
+    from ..ops.predict import build_device_tree, tree_output_on_device
+    if dataset.bundle is not None:
+        dtree = build_device_tree(
+            tree, bin_meta, max(int(dataset.bundle.num_bundled_bins), 2),
+            bundle=dataset.bundle)
+    else:
+        dtree = build_device_tree(
+            tree, bin_meta, max(int(dataset.max_num_bin), 2))
+    if dtree is None:  # stump: constant value
+        if tree.num_leaves >= 1 and tree.leaf_value[0] != 0.0:
+            return jnp.full((dataset.num_data,),
+                            np.float32(tree.leaf_value[0]))
+        return None
+    return tree_output_on_device(bins_dev, dtree)
+
+
 class ValidData:
     """One validation set: binned rows aligned with the training mappers +
     incrementally maintained scores (reference: GBDT::AddValidDataset,
-    gbdt.cpp:182, ScoreUpdater per valid set)."""
+    gbdt.cpp:182, ScoreUpdater per valid set). Bins and scores live on
+    device; per-iteration tree scoring is a vectorized device traversal
+    (ops/predict.py), not a host walk — the analogue of the reference's
+    CUDA valid-set score updater (src/boosting/cuda/cuda_score_updater.*)."""
 
     def __init__(self, dataset: BinnedDataset, metrics: List[Metric],
                  num_tree_per_iteration: int):
         self.dataset = dataset
         self.metrics = metrics
-        self.scores = np.zeros((dataset.num_data, num_tree_per_iteration),
-                               dtype=np.float64)
+        self.bins_dev = jnp.asarray(dataset.bins)
+        scores = np.zeros((dataset.num_data, num_tree_per_iteration),
+                          dtype=np.float32)
         if dataset.metadata.init_score is not None:
             init = np.asarray(dataset.metadata.init_score, dtype=np.float64)
-            self.scores += init.reshape(num_tree_per_iteration, -1).T
+            scores += init.reshape(num_tree_per_iteration, -1).T
+        self.scores_dev = jnp.asarray(scores)
 
-    def add_tree(self, tree: Tree, class_id: int, bin_meta) -> None:
-        leaf = tree.predict_by_bin(self.dataset.bins, *bin_meta)
-        if tree.is_linear and self.dataset.raw_data is not None:
-            from ..models.linear import linear_predict
-            self.scores[:, class_id] += linear_predict(
-                tree, self.dataset.raw_data, leaf)
-        else:
-            self.scores[:, class_id] += tree.leaf_value[leaf]
+    @property
+    def scores(self) -> np.ndarray:
+        """Host f64 snapshot (metrics evaluate on host)."""
+        return np.asarray(self.scores_dev, dtype=np.float64)
+
+    def add_tree(self, tree: Tree, class_id: int, bin_meta,
+                 sign: float = 1.0) -> None:
+        delta = self._tree_outputs(tree, bin_meta)
+        if delta is None:
+            return
+        if sign != 1.0:
+            delta = delta * np.float32(sign)
+        self.scores_dev = self.scores_dev.at[:, class_id].add(delta)
+
+    def _tree_outputs(self, tree: Tree, bin_meta):
+        """Device [n] f32 output of one tree over this valid set."""
+        return _device_tree_outputs(tree, self.bins_dev, self.dataset,
+                                    bin_meta)
 
     def add_const(self, val: float, class_id: int) -> None:
-        self.scores[:, class_id] += val
+        self.scores_dev = self.scores_dev.at[:, class_id].add(
+            np.float32(val))
+
+    def multiply(self, factor: float, class_id: int) -> None:
+        self.scores_dev = self.scores_dev.at[:, class_id].multiply(
+            np.float32(factor))
 
 
 class GBDT:
@@ -120,6 +168,7 @@ class GBDT:
             [] if mc is None else [int(v) for v in np.asarray(mc)])
 
         self.learner = create_tree_learner(config, train_data)
+        self._train_bins_dev = None
         self.sample_strategy = create_sample_strategy(
             config, self.num_data, self.num_tree_per_iteration)
         self.sample_strategy.reset_metadata(train_data.metadata)
@@ -339,28 +388,40 @@ class GBDT:
         K = self.num_tree_per_iteration
         for k in range(K):
             tree = self.models[-K + k]
-            # subtract the tree's contribution by re-walking the binned
-            # training rows (host traversal; rollback is rare)
-            leaf = tree.predict_by_bin(self.train_data.bins, *self._bin_meta)
-            delta = self._tree_row_outputs(tree, self.train_data, leaf)
-            self.train_score = self.train_score.at[:, k].add(
-                jnp.asarray(-delta.astype(np.float32)))
+            delta = self._tree_outputs_train(tree)
+            if delta is not None:
+                self.train_score = self.train_score.at[:, k].add(-delta)
             for vd in self.valid_data:
-                vleaf = tree.predict_by_bin(vd.dataset.bins, *self._bin_meta)
-                vd.scores[:, k] -= self._tree_row_outputs(
-                    tree, vd.dataset, vleaf)
+                vd.add_tree(tree, k, self._bin_meta, sign=-1.0)
         del self.models[-K:]
         self.iter -= 1
 
-    @staticmethod
-    def _tree_row_outputs(tree: Tree, dataset: BinnedDataset,
-                          leaf: np.ndarray) -> np.ndarray:
-        """Per-row output of one tree over a binned dataset — linear
-        leaves included (used by rollback/DART score adjustments)."""
-        if tree.is_linear and dataset.raw_data is not None:
-            from ..models.linear import linear_predict
-            return linear_predict(tree, dataset.raw_data, leaf)
-        return tree.leaf_value[leaf]
+    def _train_bins_device(self) -> jnp.ndarray:
+        """Device-resident [N, F] binned training rows, reusing the
+        learner's buffer when its layout matches (the serial learner keeps
+        [N+1, F]; feature-parallel pads features, so it gets a copy)."""
+        if self._train_bins_dev is None:
+            lb = getattr(self.learner, "bins", None)
+            if self.train_data.bundle is not None:
+                # bundled traversal needs the bundled [N, G] layout (the
+                # LUT DeviceTree reads bundle columns); mesh learners may
+                # hold an unbundled copy, so never reuse theirs here
+                self._train_bins_dev = jnp.asarray(self.train_data.bins)
+            elif lb is not None and lb.ndim == 2 \
+                    and lb.shape[0] >= self.num_data \
+                    and lb.shape[1] == self.train_data.num_features:
+                self._train_bins_dev = lb[:self.num_data]
+            else:
+                self._train_bins_dev = jnp.asarray(self.train_data.bins)
+        return self._train_bins_dev
+
+    def _tree_outputs_train(self, tree: Tree):
+        """Device [N] f32 output of one tree over the training rows (used
+        by rollback/DART score adjustments; the per-iteration score update
+        itself reuses the learner's partition in _update_score)."""
+        return _device_tree_outputs(
+            tree, self._train_bins_device(), self.train_data,
+            self._bin_meta)
 
     # ------------------------------------------------------------------
     def eval_metrics(self) -> List[Tuple[str, str, float, bool]]:
@@ -384,33 +445,36 @@ class GBDT:
                                 m.factor_to_bigger_better > 0))
         return out
 
-    def _check_early_stopping(self) -> bool:
+    def _check_early_stopping(self, eval_list) -> bool:
         """reference: GBDT::OutputMetric early-stopping bookkeeping
-        (gbdt.cpp:535)."""
+        (gbdt.cpp:535-590). Tracks every value of every metric (all
+        ``eval_at`` positions), per valid set; ``first_metric_only``
+        restricts to the first metric's values."""
         if self.config.early_stopping_round <= 0 or not self.valid_data:
             return False
         stop = False
         for i, vd in enumerate(self.valid_data):
-            score = vd.scores[:, 0] if self.num_tree_per_iteration == 1 \
-                else vd.scores
-            tracked = 0
-            for m in vd.metrics:
-                if tracked >= len(self._best_score[i]):
-                    break
-                vals = m.eval(score, self.objective)
-                factor = m.factor_to_bigger_better
-                # track only the metric's first value (reference uses
-                # vec_min/vec_max over eval_at; first is standard)
-                cur = vals[0] * (1.0 if factor > 0 else -1.0)
-                if cur > self._best_score[i][tracked]:
-                    self._best_score[i][tracked] = cur
-                    self._best_iter[i][tracked] = self.iter
-                elif (self.iter - self._best_iter[i][tracked]
+            ds_name = "valid_%d" % i
+            entries = [(name, v, bigger) for ds, name, v, bigger
+                       in eval_list if ds == ds_name]
+            if self.config.first_metric_only and vd.metrics:
+                first_names = set(vd.metrics[0].name)
+                entries = [e for e in entries if e[0] in first_names]
+            if len(self._best_score[i]) != len(entries):
+                # lazily size the per-(metric, position) trackers
+                self._best_score[i] = [_K_MIN_SCORE] * len(entries)
+                self._best_iter[i] = [0] * len(entries)
+            for j, (name, v, bigger) in enumerate(entries):
+                cur = v * (1.0 if bigger else -1.0)
+                if cur > self._best_score[i][j]:
+                    self._best_score[i][j] = cur
+                    self._best_iter[i][j] = self.iter
+                elif (self.iter - self._best_iter[i][j]
                         >= self.config.early_stopping_round):
                     stop = True
-                tracked += 1
         if stop:
-            best = max(b for bi in self._best_iter for b in bi)
+            best = max((b for bi in self._best_iter for b in bi),
+                       default=self.iter)
             self.best_iteration = best
             log.info("Early stopping at iteration %d, the best iteration "
                      "round is %d" % (self.iter, best))
@@ -420,21 +484,65 @@ class GBDT:
     def train(self, snapshot_freq: int = -1,
               model_output_path: str = "",
               callbacks: Optional[Sequence[Callable]] = None) -> None:
-        """Full training loop (reference: GBDT::Train, gbdt.cpp:229)."""
-        for it in range(self.iter, int(self.config.num_iterations)):
+        """Full training loop (reference: GBDT::Train, gbdt.cpp:229).
+
+        metric_freq gates only the *printing* of metrics; early stopping
+        evaluates every iteration like the reference (OutputMetric runs
+        whenever early_stopping_round > 0, gbdt.cpp:461). ``callbacks``
+        follow the python callback protocol (CallbackEnv; EarlyStopException
+        stops training)."""
+        from ..callback import CallbackEnv, EarlyStopException
+        callbacks = list(callbacks or [])
+        cbs_before = sorted(
+            [cb for cb in callbacks
+             if getattr(cb, "before_iteration", False)],
+            key=lambda cb: getattr(cb, "order", 0))
+        cbs_after = sorted(
+            [cb for cb in callbacks
+             if not getattr(cb, "before_iteration", False)],
+            key=lambda cb: getattr(cb, "order", 0))
+        begin_iter = self.iter
+        end_iter = int(self.config.num_iterations)
+        es_round = self.config.early_stopping_round
+        for it in range(begin_iter, end_iter):
+            for cb in cbs_before:
+                cb(CallbackEnv(model=self, params={}, iteration=it,
+                               begin_iteration=begin_iter,
+                               end_iteration=end_iter,
+                               evaluation_result_list=None))
             finished = self.train_one_iter()
-            if not finished and self.config.metric_freq > 0 \
-                    and (self.iter) % self.config.metric_freq == 0:
-                for ds, name, v, _ in self.eval_metrics():
-                    log.info("Iteration:%d, %s %s : %g"
-                             % (self.iter, ds, name, v))
-                if self._check_early_stopping():
+            eval_list = None
+            if not finished:
+                need_output = (self.config.metric_freq > 0
+                               and self.iter % self.config.metric_freq == 0)
+                need_eval = (need_output or cbs_after
+                             or (es_round > 0 and self.valid_data))
+                if need_eval:
+                    eval_list = self.eval_metrics()
+                if need_output:
+                    for ds, name, v, _ in eval_list:
+                        log.info("Iteration:%d, %s %s : %g"
+                                 % (self.iter, ds, name, v))
+                if es_round > 0 and self.valid_data \
+                        and self._check_early_stopping(eval_list):
                     # drop the over-trained models
                     K = self.num_tree_per_iteration
                     n_drop = (self.iter - self.best_iteration)
                     del self.models[len(self.models) - n_drop * K:]
                     self.iter = self.best_iteration
                     finished = True
+            try:
+                for cb in cbs_after:
+                    cb(CallbackEnv(model=self, params={}, iteration=it,
+                                   begin_iteration=begin_iter,
+                                   end_iteration=end_iter,
+                                   evaluation_result_list=[
+                                       (ds, name, v, bigger) for
+                                       ds, name, v, bigger
+                                       in (eval_list or [])]))
+            except EarlyStopException as e:
+                self.best_iteration = e.best_iteration + 1
+                finished = True
             if snapshot_freq > 0 and self.iter % snapshot_freq == 0 \
                     and model_output_path:
                 self.save_model(model_output_path
@@ -455,21 +563,60 @@ class GBDT:
         return self.models[start * K:end * K]
 
     def predict_raw(self, X: np.ndarray, start_iteration: int = 0,
-                    num_iteration: int = -1) -> np.ndarray:
+                    num_iteration: int = -1,
+                    pred_early_stop: Optional[bool] = None,
+                    pred_early_stop_freq: Optional[int] = None,
+                    pred_early_stop_margin: Optional[float] = None
+                    ) -> np.ndarray:
         X = np.asarray(X, dtype=np.float64)
         K = self.num_tree_per_iteration
-        out = np.zeros((X.shape[0], K), dtype=np.float64)
+        n = X.shape[0]
+        out = np.zeros((n, K), dtype=np.float64)
         models = self._used_models(start_iteration, num_iteration)
-        for i, tree in enumerate(models):
-            out[:, i % K] += tree.predict(X)
+        if pred_early_stop is None:
+            pred_early_stop = bool(self.config.pred_early_stop)
+        # reference restricts prediction early stop to classification
+        # (CreatePredictionEarlyStopInstance: "binary"/"multiclass" only)
+        if pred_early_stop and self.objective is not None \
+                and self.objective.name in ("binary", "multiclass",
+                                            "multiclassova"):
+            # margin-based per-row early exit (reference:
+            # src/boosting/prediction_early_stop.cpp — binary margin
+            # 2|score|, multiclass top1−top2, checked every round_period
+            # iterations)
+            freq = int(pred_early_stop_freq
+                       if pred_early_stop_freq is not None
+                       else self.config.pred_early_stop_freq)
+            margin_thr = float(pred_early_stop_margin
+                               if pred_early_stop_margin is not None
+                               else self.config.pred_early_stop_margin)
+            freq = max(freq, 1)
+            active = np.arange(n)
+            n_iters = len(models) // max(K, 1)
+            for it in range(n_iters):
+                if len(active) == 0:
+                    break
+                Xa = X[active]
+                for k in range(K):
+                    out[active, k] += models[it * K + k].predict(Xa)
+                if (it + 1) % freq == 0 and it + 1 < n_iters:
+                    if K == 1:
+                        margin = 2.0 * np.abs(out[active, 0])
+                    else:
+                        part = np.partition(out[active], K - 2, axis=1)
+                        margin = part[:, K - 1] - part[:, K - 2]
+                    active = active[margin < margin_thr]
+        else:
+            for i, tree in enumerate(models):
+                out[:, i % K] += tree.predict(X)
         if self.average_output and models:
             out /= max(len(models) // K, 1)
         return out[:, 0] if K == 1 else out
 
     def predict(self, X: np.ndarray, raw_score: bool = False,
                 start_iteration: int = 0,
-                num_iteration: int = -1) -> np.ndarray:
-        raw = self.predict_raw(X, start_iteration, num_iteration)
+                num_iteration: int = -1, **kwargs) -> np.ndarray:
+        raw = self.predict_raw(X, start_iteration, num_iteration, **kwargs)
         if raw_score or self.objective is None:
             return raw
         return self.objective.convert_output(raw)

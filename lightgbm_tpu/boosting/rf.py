@@ -53,7 +53,7 @@ class RF(GBDT):
         self.train_score = self.train_score.at[:, class_id].multiply(
             np.float32(factor))
         for vd in self.valid_data:
-            vd.scores[:, class_id] *= factor
+            vd.multiply(factor, class_id)
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
         assert grad is None and hess is None, \

@@ -418,7 +418,33 @@ class Config:
             log.fatal("num_class must be 1 for non-multiclass objectives")
         if self.top_rate + self.other_rate > 1.0:
             log.fatal("top_rate + other_rate cannot be larger than 1.0")
+        self._warn_unimplemented()
         log.set_verbosity(self.verbosity)
+
+    def _warn_unimplemented(self) -> None:
+        """Accepted-but-not-yet-implemented knobs warn LOUDLY instead of
+        silently corrupting experiments (round-2 review, Weak #5).
+        Pure CPU-layout hints are no-ops by design on the TPU build."""
+        if (self.cegb_tradeoff != 1.0 or self.cegb_penalty_split != 0.0
+                or self.cegb_penalty_feature_lazy
+                or self.cegb_penalty_feature_coupled):
+            log.warning("CEGB (cegb_*) is not implemented yet; the "
+                        "penalties are IGNORED")
+        if self.monotone_penalty != 0.0:
+            log.warning("monotone_penalty is not implemented yet and is "
+                        "IGNORED")
+        if self.monotone_constraints_method not in ("basic",):
+            log.warning("monotone_constraints_method=%s is not implemented;"
+                        " falling back to 'basic'"
+                        % self.monotone_constraints_method)
+            self.monotone_constraints_method = "basic"
+        if self.two_round:
+            log.warning("two_round loading is a CPU-memory staging hint "
+                        "with no effect in this build")
+        if self.force_col_wise or self.force_row_wise:
+            log.warning("force_col_wise/force_row_wise are CPU histogram "
+                        "layout hints; the TPU build always uses one "
+                        "row-major device layout")
 
     @staticmethod
     def _resolve_metrics(metrics: Any) -> List[str]:

@@ -59,7 +59,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
         # replay existing model onto the training scores
         import numpy as _np
         import jax.numpy as jnp
-        bins = booster.inner.train_data.bins
+        bins = booster.inner.train_data.feature_bins()
         for i, tree in enumerate(booster.inner.models):
             k = i % booster.inner.num_tree_per_iteration
             leaf = tree.predict_by_bin(bins, *booster.inner._bin_meta)

@@ -30,6 +30,7 @@ def save_binary(dataset: BinnedDataset, path: str) -> None:
         "max_num_bin": dataset.max_num_bin,
         "monotone_constraints": dataset.monotone_constraints,
         "feature_penalty": dataset.feature_penalty,
+        "bundle": dataset.bundle,
     }
     md = dataset.metadata
     np.savez_compressed(
@@ -64,6 +65,7 @@ def load_binary(path: str) -> BinnedDataset:
     ds.max_num_bin = meta["max_num_bin"]
     ds.monotone_constraints = meta["monotone_constraints"]
     ds.feature_penalty = meta["feature_penalty"]
+    ds.bundle = meta.get("bundle")
     n = ds.bins.shape[0]
     md = Metadata(n)
     md.set_label(z["label"])

@@ -107,6 +107,8 @@ class BinnedDataset:
         self.monotone_constraints: Optional[np.ndarray] = None
         self.feature_penalty: Optional[np.ndarray] = None
         self.raw_data: Optional[np.ndarray] = None  # kept for linear trees
+        # EFB: when set, ``bins`` is the bundled [N, G] matrix (io/efb.py)
+        self.bundle = None
 
     # ------------------------------------------------------------------
     @classmethod
@@ -122,12 +124,29 @@ class BinnedDataset:
         """Build from a dense float matrix (reference:
         DatasetLoader::ConstructFromSampleData, src/io/dataset_loader.cpp:593,
         for the sample pass; Dataset::PushRow + FinishLoad for the full pass)."""
-        data = np.asarray(data)
-        if data.dtype not in (np.float32, np.float64):
-            data = data.astype(np.float64)
-        if data.ndim != 2:
-            log.fatal("Training data must be 2-dimensional")
+        is_sparse = hasattr(data, "tocsc")
+        if is_sparse:
+            # scipy input stays sparse until binning (reference analogue:
+            # SparseBin, src/io/sparse_bin.hpp — here sparsity is
+            # exploited via per-column binning + EFB bundling instead of
+            # a delta-encoded store)
+            data = data.tocsc()
+            if keep_raw_data:
+                log.fatal("keep_raw_data/linear_tree requires dense input")
+        else:
+            data = np.asarray(data)
+            if data.dtype not in (np.float32, np.float64):
+                data = data.astype(np.float64)
+            if data.ndim != 2:
+                log.fatal("Training data must be 2-dimensional")
         n, num_total_features = data.shape
+
+        def full_col(f: int) -> np.ndarray:
+            if is_sparse:
+                return np.asarray(
+                    data[:, [f]].todense(), dtype=np.float64).ravel()
+            return data[:, f]
+
         self = cls()
         self.num_total_features = num_total_features
         self.feature_names = list(feature_names) if feature_names else [
@@ -147,15 +166,15 @@ class BinnedDataset:
             self.max_num_bin = reference.max_num_bin
             self.monotone_constraints = reference.monotone_constraints
             self.feature_penalty = reference.feature_penalty
+            self.bundle = reference.bundle
         else:
             # --- sampling pass (bin_construct_sample_cnt, config.h:641) ---
             sample_cnt = min(config.bin_construct_sample_cnt, n)
             rng = np.random.RandomState(config.data_random_seed)
             if sample_cnt < n:
                 sample_idx = np.sort(rng.choice(n, sample_cnt, replace=False))
-                sample = data[sample_idx]
             else:
-                sample = data
+                sample_idx = None
             max_bin_by_feature = config.max_bin_by_feature
             if max_bin_by_feature:
                 # reference: src/io/dataset_loader.cpp:614-616 CHECK_EQ/CHECK_GT
@@ -166,12 +185,15 @@ class BinnedDataset:
                 if min(max_bin_by_feature) <= 1:
                     log.fatal("Each entry of max_bin_by_feature must be > 1")
             mappers: List[BinMapper] = []
+            sample_bin_cols: List[np.ndarray] = []
             for f in range(num_total_features):
                 bm = BinMapper()
                 max_bin_f = (max_bin_by_feature[f]
                              if f < len(max_bin_by_feature) else config.max_bin)
+                col = full_col(f)
+                sample_col = col if sample_idx is None else col[sample_idx]
                 bm.find_bin(
-                    sample[:, f], total_sample_cnt=len(sample),
+                    sample_col, total_sample_cnt=len(sample_col),
                     max_bin=max_bin_f,
                     min_data_in_bin=config.min_data_in_bin,
                     min_split_data=config.min_data_in_leaf,
@@ -181,6 +203,9 @@ class BinnedDataset:
                     use_missing=config.use_missing,
                     zero_as_missing=config.zero_as_missing)
                 mappers.append(bm)
+                if not bm.is_trivial:
+                    sample_bin_cols.append(
+                        bm.value_to_bin(sample_col).astype(np.int32))
             self.bin_mappers = [m for m in mappers if not m.is_trivial]
             self.used_feature_map = [i for i, m in enumerate(mappers)
                                      if not m.is_trivial]
@@ -194,14 +219,27 @@ class BinnedDataset:
             self.max_num_bin = int(self.num_bin_per_feature.max()) if len(
                 self.num_bin_per_feature) else 1
             self._set_constraints(config)
+            if config.enable_bundle and len(self.bin_mappers) > 1:
+                self._find_bundles(sample_bin_cols, config)
 
         # --- full binning pass ---
-        dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
-        bins = np.empty((n, len(self.bin_mappers)), dtype=dtype)
-        for j, (f, bm) in enumerate(zip(self.used_feature_map,
-                                        self.bin_mappers)):
-            bins[:, j] = bm.value_to_bin(data[:, f]).astype(dtype)
-        self.bins = bins
+        if self.bundle is not None:
+            from .efb import bundle_columns
+            dtype = (np.uint8 if self.bundle.num_bundled_bins <= 256
+                     else np.uint16)
+            zero_bins = np.asarray([m.default_bin for m in self.bin_mappers],
+                                   dtype=np.int32)
+            self.bins = bundle_columns(
+                lambda j: self.bin_mappers[j].value_to_bin(
+                    full_col(self.used_feature_map[j])),
+                self.bundle, zero_bins, n, dtype)
+        else:
+            dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
+            bins = np.empty((n, len(self.bin_mappers)), dtype=dtype)
+            for j, (f, bm) in enumerate(zip(self.used_feature_map,
+                                            self.bin_mappers)):
+                bins[:, j] = bm.value_to_bin(full_col(f)).astype(dtype)
+            self.bins = bins
         if keep_raw_data:
             self.raw_data = data
 
@@ -229,13 +267,72 @@ class BinnedDataset:
             self.feature_penalty = fp
 
     # ------------------------------------------------------------------
+    def _find_bundles(self, sample_bin_cols: List[np.ndarray],
+                      config: Config) -> None:
+        """Greedy EFB over the sampled binned columns (reference:
+        Dataset::FindGroups, src/io/dataset.cpp:107). Only numerical,
+        non-NaN-missing, mostly-zero features are candidates."""
+        from .efb import build_layout, find_groups
+        F = len(self.bin_mappers)
+        if not sample_bin_cols or F < 2:
+            return
+        sample_cnt = len(sample_bin_cols[0])
+        zero_bins = np.asarray([m.default_bin for m in self.bin_mappers],
+                               dtype=np.int32)
+        masks: List[Optional[np.ndarray]] = []
+        for j, m in enumerate(self.bin_mappers):
+            if (m.bin_type == BinType.CATEGORICAL
+                    or m.missing_type == MissingType.NAN
+                    or m.num_bin < 2):
+                masks.append(None)
+                continue
+            nz = sample_bin_cols[j] != zero_bins[j]
+            # bundling only pays off on sparse columns (reference:
+            # kSparseThreshold, include/LightGBM/bin.h:39)
+            masks.append(nz if nz.mean() <= 0.3 else None)
+        if all(mk is None for mk in masks):
+            return
+        max_bundle_bins = max(self.max_num_bin, min(config.max_bin + 1, 256))
+        groups = find_groups(masks, self.num_bin_per_feature, sample_cnt,
+                             max_bundle_bins)
+        if all(len(g) == 1 for g in groups):
+            return
+        self.bundle = build_layout(groups, self.num_bin_per_feature,
+                                   zero_bins, self.max_num_bin)
+        log.info("EFB: bundled %d features into %d columns"
+                 % (F, self.bundle.num_groups))
+
+    def feature_bin_column(self, j: int) -> np.ndarray:
+        """Per-feature bin column, unbundling if needed (host)."""
+        if self.bundle is None:
+            return self.bins[:, j]
+        lay = self.bundle
+        g = int(lay.group_of[j])
+        col = self.bins[:, g].astype(np.int64)
+        zb = self.bin_mappers[j].default_bin
+        return np.where(lay.member[g][col] == j, lay.unmap[g][col],
+                        zb).astype(self.bins.dtype)
+
+    def feature_bins(self) -> np.ndarray:
+        """[N, F] per-feature bin matrix; materializes when bundled
+        (memory-heavy on wide sparse data — only host traversal paths
+        need it)."""
+        if self.bundle is None:
+            return self.bins
+        out = np.empty((self.bins.shape[0], len(self.bin_mappers)),
+                       dtype=self.bins.dtype)
+        for j in range(len(self.bin_mappers)):
+            out[:, j] = self.feature_bin_column(j)
+        return out
+
+    # ------------------------------------------------------------------
     @property
     def num_data(self) -> int:
         return self.bins.shape[0]
 
     @property
     def num_features(self) -> int:
-        return self.bins.shape[1]
+        return len(self.bin_mappers)
 
     def real_threshold(self, feature: int, bin_idx: int) -> float:
         """Bin index -> real-valued split threshold for model storage

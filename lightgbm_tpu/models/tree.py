@@ -214,53 +214,107 @@ class Tree:
         return self.leaf_value[leaf]
 
     def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
-        """Batch traversal; at most num_leaves-1 hops."""
+        """Lockstep vectorized traversal: all rows advance one level per
+        pass, all node types decided at once (reference: tree.h:133
+        Predict over NumericalDecision/CategoricalDecision — here the
+        per-row branch walk becomes array ops over the flat node
+        arrays)."""
         n = X.shape[0]
         if self.num_leaves == 1:
             return np.zeros(n, dtype=np.int32)
+        ni = self.num_internal
+        feat = self.split_feature[:ni]
+        thr = self.threshold[:ni]
+        dt = self.decision_type[:ni].astype(np.int64)
+        is_cat = (dt & kCategoricalMask) != 0
+        default_left = (dt & kDefaultLeftMask) != 0
+        missing = (dt >> 2) & 3
+        left, right = self.left_child[:ni], self.right_child[:ni]
+        has_cat = bool(is_cat.any())
+        if has_cat:
+            boundaries = np.asarray(self.cat_boundaries, dtype=np.int64)
+            words = np.asarray(self.cat_threshold, dtype=np.uint64)
+            cat_idx = self.threshold_in_bin[:ni].astype(np.int64)
         node = np.zeros(n, dtype=np.int32)   # >=0 internal, <0 = ~leaf
-        active = np.ones(n, dtype=bool)
-        while active.any():
-            for nd in np.unique(node[active]):
-                rows = active & (node == nd)
-                go_left = self._decide(X[rows, self.split_feature[nd]], nd)
-                node[rows] = np.where(go_left, self.left_child[nd],
-                                      self.right_child[nd])
-            active = node >= 0
+        for _ in range(ni):
+            act = np.nonzero(node >= 0)[0]
+            if len(act) == 0:
+                break
+            nd = node[act]
+            fv = X[act, feat[nd]]
+            m = missing[nd]
+            isnan = np.isnan(fv)
+            v = np.where(isnan & (m != MissingType.NAN), 0.0, fv)
+            gl = v <= thr[nd]
+            gl = np.where((m == MissingType.ZERO)
+                          & (np.abs(v) <= kZeroThreshold),
+                          default_left[nd], gl)
+            gl = np.where((m == MissingType.NAN) & isnan,
+                          default_left[nd], gl)
+            if has_cat:
+                cn = is_cat[nd]
+                if cn.any():
+                    iv = np.where(isnan, -1.0, fv).astype(np.int64)
+                    # non-cat nodes carry numeric bins in threshold_in_bin;
+                    # clamp them out of the boundaries lookup
+                    ci = np.clip(np.where(cn, cat_idx[nd], 0), 0,
+                                 len(boundaries) - 2)
+                    n_words = boundaries[ci + 1] - boundaries[ci]
+                    ok = (iv >= 0) & (iv // 32 < n_words)
+                    pos = np.clip(boundaries[ci] + iv // 32, 0,
+                                  max(len(words) - 1, 0))
+                    bits = (words[pos] >> (iv % 32).astype(np.uint64)) & 1
+                    gl = np.where(cn, ok & (bits > 0), gl)
+            node[act] = np.where(gl, left[nd], right[nd])
         return (~node).astype(np.int32)
 
     def predict_by_bin(self, bins: np.ndarray,
                        nan_bins: np.ndarray,
                        zero_bins: np.ndarray,
                        missing_types: np.ndarray) -> np.ndarray:
-        """Traversal over pre-binned rows (training-time scores). ``bins`` is
+        """Lockstep vectorized traversal over pre-binned rows. ``bins`` is
         [n, F_inner]; per-inner-feature metadata arrays resolve missing bins."""
         n = bins.shape[0]
-        node = np.zeros(n, dtype=np.int32)
         if self.num_leaves == 1:
             return np.zeros(n, dtype=np.int32)
-        active = np.ones(n, dtype=bool)
-        while active.any():
-            for nd in np.unique(node[active]):
-                rows = active & (node == nd)
-                f = self.split_feature_inner[nd]
-                b = bins[rows, f]
-                if self.decision_type[nd] & kCategoricalMask:
-                    mask = self.cat_bin_masks[nd]
-                    go_left = mask[np.minimum(b, len(mask) - 1)]
-                else:
-                    go_left = b <= self.threshold_in_bin[nd]
-                    default_left = bool(self.decision_type[nd]
-                                        & kDefaultLeftMask)
-                    if missing_types[f] == MissingType.NAN:
-                        go_left = np.where(b == nan_bins[f], default_left,
-                                           go_left)
-                    elif missing_types[f] == MissingType.ZERO:
-                        go_left = np.where(b == zero_bins[f], default_left,
-                                           go_left)
-                node[rows] = np.where(go_left, self.left_child[nd],
-                                      self.right_child[nd])
-            active = node >= 0
+        ni = self.num_internal
+        feat = self.split_feature_inner[:ni]
+        tbin = self.threshold_in_bin[:ni]
+        dt = self.decision_type[:ni].astype(np.int64)
+        is_cat = (dt & kCategoricalMask) != 0
+        default_left = (dt & kDefaultLeftMask) != 0
+        left, right = self.left_child[:ni], self.right_child[:ni]
+        # per-node missing-bin ids (-1 disables the compare)
+        node_nan = np.where(missing_types[feat] == MissingType.NAN,
+                            nan_bins[feat], -1)
+        node_zero = np.where(missing_types[feat] == MissingType.ZERO,
+                             zero_bins[feat], -1)
+        has_cat = bool(is_cat.any())
+        if has_cat:
+            max_b = max((len(m) for m in self.cat_bin_masks.values()),
+                        default=1)
+            cat_tbl = np.zeros((ni, max_b), dtype=bool)
+            for nd_i, mask in self.cat_bin_masks.items():
+                if nd_i < ni:
+                    m = np.asarray(mask, dtype=bool)
+                    cat_tbl[nd_i, :len(m)] = m[:max_b]
+        node = np.zeros(n, dtype=np.int32)
+        for _ in range(ni):
+            act = np.nonzero(node >= 0)[0]
+            if len(act) == 0:
+                break
+            nd = node[act]
+            b = bins[act, feat[nd]].astype(np.int64)
+            gl = b <= tbin[nd]
+            gl = np.where(b == node_nan[nd], default_left[nd], gl)
+            gl = np.where(b == node_zero[nd], default_left[nd], gl)
+            if has_cat:
+                cn = is_cat[nd]
+                if cn.any():
+                    gl = np.where(cn,
+                                  cat_tbl[nd, np.minimum(b, max_b - 1)],
+                                  gl)
+            node[act] = np.where(gl, left[nd], right[nd])
         return (~node).astype(np.int32)
 
     # ------------------------------------------------------------------

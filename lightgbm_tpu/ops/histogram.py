@@ -83,3 +83,28 @@ def subtract_histogram(parent: jnp.ndarray, child: jnp.ndarray) -> jnp.ndarray:
     """Sibling histogram via subtraction (reference:
     serial_tree_learner.cpp:421-424 ``larger.Subtract(smaller)``)."""
     return parent - child
+
+
+def unpack_bundle_histogram(bhist: jnp.ndarray,
+                            gidx_g: jnp.ndarray, gidx_b: jnp.ndarray,
+                            zero_fix: jnp.ndarray, zero_bins: jnp.ndarray,
+                            totals: jnp.ndarray) -> jnp.ndarray:
+    """Bundle histogram [G, Bg, C] → per-feature histogram [F, B, C].
+
+    EFB support (reference: the per-feature slicing of FeatureGroup
+    histograms + FixHistogram zero-bin reconstruction,
+    src/io/dataset.cpp): a bundled feature's non-zero bins gather 1:1
+    from its bundle sub-range (static index tables ``gidx_g``/``gidx_b``,
+    -1 = no source), and its zero-bin row is leaf_total − Σ(non-zero) —
+    exclusivity means rows under other members' bins are zero rows of
+    this feature.
+
+    totals : f32[C] — the leaf's (grad, hess, count, total) sums.
+    """
+    F = gidx_g.shape[0]
+    safe_g = jnp.maximum(gidx_g, 0)
+    hist = bhist[safe_g, gidx_b]                       # [F, B, C]
+    hist = jnp.where((gidx_g >= 0)[..., None], hist, 0.0)
+    resid = totals[None, :] - jnp.sum(hist, axis=1)    # [F, C]
+    fix = jnp.where(zero_fix[:, None], resid, 0.0)
+    return hist.at[jnp.arange(F), zero_bins].add(fix)

@@ -51,6 +51,7 @@ class SplitParams(NamedTuple):
     cat_smooth: jnp.ndarray
     min_data_per_group: jnp.ndarray
     max_cat_threshold: jnp.ndarray
+    path_smooth: jnp.ndarray = 0.0
 
     @classmethod
     def from_config(cls, config) -> "SplitParams":
@@ -65,6 +66,7 @@ class SplitParams(NamedTuple):
             cat_smooth=jnp.float32(config.cat_smooth),
             min_data_per_group=jnp.float32(config.min_data_per_group),
             max_cat_threshold=jnp.int32(config.max_cat_threshold),
+            path_smooth=jnp.float32(config.path_smooth),
         )
 
 
@@ -103,6 +105,27 @@ class FeatureMeta(NamedTuple):
                 is_cat & (num_bin <= max_cat_to_onehot)),
             monotone=jnp.asarray(monotone),
         )
+
+
+def pad_feature_meta(meta: "FeatureMeta", pad: int) -> "FeatureMeta":
+    """Append ``pad`` trivial features (num_bin 1 → never a valid split
+    candidate). Used to pad the feature axis to a canonical width so
+    compiled step variants are shared across datasets."""
+    if pad <= 0:
+        return meta
+
+    def padv(a, fill):
+        return jnp.concatenate(
+            [a, jnp.full((pad,), fill, dtype=a.dtype)])
+
+    return FeatureMeta(
+        num_bin=padv(meta.num_bin, 1),
+        missing_type=padv(meta.missing_type, 0),
+        zero_bin=padv(meta.zero_bin, 0),
+        is_categorical=padv(meta.is_categorical, False),
+        use_onehot=padv(meta.use_onehot, False),
+        monotone=padv(meta.monotone, 0),
+    )
 
 
 class SplitInfo(NamedTuple):
@@ -175,6 +198,40 @@ def leaf_gain(sum_grad, sum_hess, p: SplitParams, l2=None):
         p, l2)
 
 
+def smooth_output(out, count, parent_output, p: SplitParams):
+    """Path smoothing toward the parent's output (reference:
+    CalculateSplittedLeafOutput USE_SMOOTHING branch,
+    feature_histogram.hpp:743-765): out*(n/α)/(n/α+1) + parent/(n/α+1),
+    applied after max_delta_step clipping, before monotone clamping."""
+    alpha = jnp.maximum(p.path_smooth, jnp.float32(1e-30))
+    f = count / alpha
+    smoothed = out * f / (f + 1.0) + parent_output / (f + 1.0)
+    return jnp.where(p.path_smooth > kSmoothEps, smoothed, out)
+
+
+kSmoothEps = 1e-15
+
+
+def make_rand_bins(key, meta: "FeatureMeta", params: SplitParams):
+    """extra_trees (config.h:368): one random candidate threshold per
+    feature per leaf (reference: meta_->rand.NextInt calls in
+    feature_histogram.hpp:109,321,402). Returns (numerical threshold,
+    one-hot bin, sorted-prefix position) per feature."""
+    kn, ko, ks = jax.random.split(key, 3)
+    F = meta.num_bin.shape[0]
+    rand_num = jnp.floor(
+        jax.random.uniform(kn, (F,))
+        * jnp.maximum(meta.num_bin - 2, 1)).astype(jnp.int32)
+    rand_oh = 1 + jnp.floor(
+        jax.random.uniform(ko, (F,))
+        * jnp.maximum(meta.num_bin - 1, 1)).astype(jnp.int32)
+    max_thr = jnp.maximum(
+        jnp.minimum(params.max_cat_threshold, (meta.num_bin + 1) // 2), 1)
+    rand_sorted = jnp.floor(
+        jax.random.uniform(ks, (F,)) * max_thr).astype(jnp.int32)
+    return rand_num, rand_oh, rand_sorted
+
+
 def find_best_split(hist: jnp.ndarray,
                     sum_grad: jnp.ndarray,
                     sum_hess: jnp.ndarray,
@@ -184,7 +241,9 @@ def find_best_split(hist: jnp.ndarray,
                     params: SplitParams,
                     feature_mask: jnp.ndarray,
                     min_output=None,
-                    max_output=None) -> SplitInfo:
+                    max_output=None,
+                    parent_output=None,
+                    rand_bins=None) -> SplitInfo:
     """Scan a leaf histogram for the best (feature, threshold) pair.
 
     Parameters
@@ -203,14 +262,17 @@ def find_best_split(hist: jnp.ndarray,
         min_output = jnp.float32(-jnp.inf)
     if max_output is None:
         max_output = jnp.float32(jnp.inf)
+    if parent_output is None:
+        parent_output = jnp.float32(0.0)
 
-    def bounded_output(sg, sh, l2=None):
-        return jnp.clip(calculate_leaf_output(sg, sh, params, l2),
-                        min_output, max_output)
+    def bounded_output(sg, sh, n, l2=None):
+        out = calculate_leaf_output(sg, sh, params, l2)
+        out = smooth_output(out, n, parent_output, params)
+        return jnp.clip(out, min_output, max_output)
 
-    def bounded_gain(sg, sh, l2=None):
+    def bounded_gain(sg, sh, n, l2=None):
         return leaf_gain_given_output(
-            sg, sh, bounded_output(sg, sh, l2), params, l2)
+            sg, sh, bounded_output(sg, sh, n, l2), params, l2)
 
     is_cat = meta.is_categorical                                 # [F]
     is_num = ~is_cat
@@ -240,6 +302,9 @@ def find_best_split(hist: jnp.ndarray,
     t_max = jnp.where(is_nan_missing[:, None], num_bin - 2, num_bin - 1)
     valid_t = (bin_ids < t_max) & feature_mask[:, None] \
         & is_num[:, None]                                        # [F, B]
+    if rand_bins is not None:
+        # extra_trees: only the per-feature random threshold is a candidate
+        valid_t = valid_t & (bin_ids == rand_bins[0][:, None])
 
     mono = meta.monotone.astype(jnp.int32)[:, None]              # [F, 1]
 
@@ -249,8 +314,8 @@ def find_best_split(hist: jnp.ndarray,
               (rc >= params.min_data_in_leaf) &
               (lh >= params.min_sum_hessian_in_leaf) &
               (rh >= params.min_sum_hessian_in_leaf))
-        out_l = bounded_output(lg, lh)
-        out_r = bounded_output(rg, rh)
+        out_l = bounded_output(lg, lh, lc)
+        out_r = bounded_output(rg, rh, rc)
         # monotone filtering (reference: BasicLeafConstraints split
         # rejection, monotone_constraints.hpp)
         mono_ok = ~(((mono > 0) & (out_l > out_r))
@@ -287,8 +352,10 @@ def find_best_split(hist: jnp.ndarray,
              & ((sum_c_ - c) >= params.min_data_in_leaf)
              & ((sum_h_ - h - kEps)
                 >= params.min_sum_hessian_in_leaf))
-    gain_oh = bounded_gain(g, h + kEps) \
-        + bounded_gain(sum_g_ - g, sum_h_ - h - kEps)
+    if rand_bins is not None:
+        oh_ok = oh_ok & (bin_ids == rand_bins[1][:, None])
+    gain_oh = bounded_gain(g, h + kEps, c) \
+        + bounded_gain(sum_g_ - g, sum_h_ - h - kEps, sum_c_ - c)
     gain_oh = jnp.where(oh_ok, gain_oh, _NEG_INF)
 
     # sorted-subset mode (l2 += cat_l2; sort by g/(h+cat_smooth))
@@ -341,8 +408,8 @@ def find_best_split(hist: jnp.ndarray,
             (jnp.zeros(F), jnp.zeros(F, dtype=bool)),
             (scd.T, cont.T, brk.T, pos_ok.T))
         can_eval = can_eval.T                                    # [F, B]
-        gains = bounded_gain(lg, lh, cat_l2) \
-            + bounded_gain(rg, rh, cat_l2)
+        gains = bounded_gain(lg, lh, lc, cat_l2) \
+            + bounded_gain(rg, rh, rc, cat_l2)
         return jnp.where(can_eval, gains, _NEG_INF), (lg, lh, lc, ltc)
 
     gain_cs_f, stats_f = cat_dir_scan(sg_s, sh_s, sc_s, stc_s)
@@ -359,14 +426,41 @@ def find_best_split(hist: jnp.ndarray,
     gain_cs_r, stats_r = cat_dir_scan(
         rev_eligible(sg_s), rev_eligible(sh_s), rev_eligible(sc_s),
         rev_eligible(stc_s))
+    if rand_bins is not None:
+        # extra_trees sorted-subset mode: only the random prefix length
+        # (reference: rand.NextInt(0, max_threshold), fh.hpp:402)
+        rs = rand_bins[2][:, None] == bin_ids
+        gain_cs_f = jnp.where(rs, gain_cs_f, _NEG_INF)
+        gain_cs_r = jnp.where(rs, gain_cs_r, _NEG_INF)
 
-    gains = jnp.stack([gain_r, gain_l, gain_oh, gain_cs_f, gain_cs_r])
-    parent_gain = leaf_gain(sum_grad, sum_hess, params)
-    shift = parent_gain + params.min_gain_to_split
+    # Parent-gain baseline, subtracted per variant BEFORE the argmax
+    # (reference: min_gain_shift). Under path smoothing the numerical
+    # baseline recomputes the smoothed own-output (BeforeNumercal,
+    # fh.hpp:99-110) while the categorical baseline scores the stored
+    # parent output directly (fh.hpp:294-303); without smoothing both
+    # reduce to the plain closed form.
+    parent_gain_plain = leaf_gain(sum_grad, sum_hess, params)
+    own_out = calculate_leaf_output(sum_grad, sum_hess, params)
+    own_smoothed = smooth_output(own_out, sum_count, parent_output, params)
+    use_smooth = params.path_smooth > kSmoothEps
+    parent_gain_num = jnp.where(
+        use_smooth,
+        leaf_gain_given_output(sum_grad, sum_hess, own_smoothed, params),
+        parent_gain_plain)
+    parent_gain_cat = jnp.where(
+        use_smooth,
+        leaf_gain_given_output(sum_grad, sum_hess, parent_output, params),
+        parent_gain_plain)
+    shift_num = parent_gain_num + params.min_gain_to_split
+    shift_cat = parent_gain_cat + params.min_gain_to_split
+
+    gains = jnp.stack([gain_r - shift_num, gain_l - shift_num,
+                       gain_oh - shift_cat, gain_cs_f - shift_cat,
+                       gain_cs_r - shift_cat])
 
     flat = gains.reshape(-1)
     best = jnp.argmax(flat)
-    best_gain_abs = flat[best]
+    best_gain_rel = flat[best]
     variant, rem = best // (F * B), best % (F * B)
     feature, tbin = (rem // B).astype(jnp.int32), (rem % B).astype(jnp.int32)
 
@@ -397,8 +491,8 @@ def find_best_split(hist: jnp.ndarray,
     rg, rh, rc = sum_grad - lg, sum_hess - lh, sum_count - lc
     rtc = sum_total_count - ltc
 
-    gain_rel = best_gain_abs - shift
-    is_valid = jnp.isfinite(best_gain_abs) & (gain_rel > 0.0)
+    gain_rel = best_gain_rel
+    is_valid = jnp.isfinite(best_gain_rel) & (gain_rel > 0.0)
 
     default_left = jnp.where(
         winner_is_cat, False,
@@ -420,10 +514,8 @@ def find_best_split(hist: jnp.ndarray,
         jnp.zeros(B, dtype=bool))
 
     out_l2 = jnp.where(variant >= 3, cat_l2, params.lambda_l2)
-    out_left = jnp.clip(calculate_leaf_output(lg, lh, params, out_l2),
-                        min_output, max_output)
-    out_right = jnp.clip(calculate_leaf_output(rg, rh, params, out_l2),
-                         min_output, max_output)
+    out_left = bounded_output(lg, lh, lc, out_l2)
+    out_right = bounded_output(rg, rh, rc, out_l2)
     # children bounds (reference: BasicLeafConstraints::Update — the
     # mid-point between child outputs caps the monotone side)
     mc_w = jnp.where(winner_is_cat, 0,

@@ -36,7 +36,9 @@ from ..models.tree import Tree
 from ..ops.histogram import build_histogram, subtract_histogram
 from ..ops.split import FeatureMeta, SplitParams, find_best_split
 from ..treelearner.serial import (GrowState, SplitRecord, _go_left_by_bin,
-                                  _record_at, _store_info, _NEG_INF)
+                                  _record_at, _store_info, _NEG_INF,
+                                  apply_split_record, make_root_state,
+                                  record_is_valid)
 from ..utils import log
 
 
@@ -64,18 +66,30 @@ class DataParallelTreeLearner:
         self.dataset = dataset
         self.mesh = mesh
         self.axis = axis
-        N, F = dataset.bins.shape
+        if dataset.bundle is not None:
+            # EFB routing is implemented in the serial learner only; the
+            # mesh learners unbundle to per-feature columns (memory cost,
+            # same semantics)
+            log.warning("mesh-parallel learners run EFB-bundled datasets "
+                        "unbundled")
+            bins_host_full = dataset.feature_bins()
+        else:
+            bins_host_full = dataset.bins
+        N, F = bins_host_full.shape
         if F == 0:
             log.fatal("Cannot train without features")
         self.N, self.F = N, F
-        self.B = max(int(dataset.max_num_bin), 2)
+        # power-of-two histogram width (see SerialTreeLearner: canonical
+        # shapes share compiled variants across datasets)
+        from ..utils import next_pow2
+        self.B = next_pow2(max(int(dataset.max_num_bin), 2))
         self.L = int(config.num_leaves)
         self.max_depth = int(config.max_depth)
         n_dev = mesh.devices.size
         # pad rows to a devices multiple; pad rows carry leaf -1 / gh 0
         self.R = -(-N // n_dev) * n_dev
-        pad = np.zeros((self.R - N, F), dtype=dataset.bins.dtype)
-        bins_host = np.concatenate([dataset.bins, pad], axis=0)
+        pad = np.zeros((self.R - N, F), dtype=bins_host_full.dtype)
+        bins_host = np.concatenate([bins_host_full, pad], axis=0)
         self.row_sharding = NamedSharding(mesh, P(self.axis))
         self.rep_sharding = NamedSharding(mesh, P())
         # histograms: replicated after the cross-row psum (the
@@ -93,6 +107,10 @@ class DataParallelTreeLearner:
         self._ff_rng = np.random.RandomState(config.feature_fraction_seed)
         self._root_fn = None
         self._step_fn = None
+        if getattr(config, "extra_trees", False):
+            log.warning("extra_trees is only implemented in the serial "
+                        "(single-chip) learner; the mesh-parallel learners "
+                        "run full greedy threshold scans")
 
     # ------------------------------------------------------------------
     def _sample_features(self) -> jnp.ndarray:
@@ -105,43 +123,27 @@ class DataParallelTreeLearner:
         return jax.device_put(jnp.asarray(mask), self.rep_sharding)
 
     # ------------------------------------------------------------------
-    def _root_impl(self, gh, feature_mask, children_allowed):
-        hist = build_histogram(self.bins, gh, self.B)
+    def _root_impl(self, bins, gh, feature_mask, children_allowed):
+        hist = build_histogram(bins, gh, self.B)
         hist = jax.lax.with_sharding_constraint(hist, self.hist_sharding)
         sums = jnp.sum(gh, axis=0)
+        from ..ops.split import calculate_leaf_output
+        parent_out = calculate_leaf_output(sums[0], sums[1], self.params)
         info = find_best_split(hist, sums[0], sums[1], sums[2], sums[3],
-                               self.meta, self.params, feature_mask)
-        L, F, B = self.L, self.F, self.B
+                               self.meta, self.params, feature_mask,
+                               parent_output=parent_out)
         leaf_of_row = jnp.concatenate([
             jnp.zeros(self.N, dtype=jnp.int32),
             jnp.full((self.R - self.N,), -1, dtype=jnp.int32)])
         leaf_of_row = jax.lax.with_sharding_constraint(
             leaf_of_row, self.row_sharding)
-        zf = lambda: jnp.zeros(L, dtype=jnp.float32)
-        state = GrowState(
-            leaf_of_row=leaf_of_row, gh=gh,
-            hists=jnp.zeros((L, F, B, 4), dtype=jnp.float32).at[0].set(hist),
-            gain=jnp.full(L, _NEG_INF, dtype=jnp.float32),
-            feature=jnp.full(L, -1, dtype=jnp.int32),
-            threshold_bin=jnp.zeros(L, dtype=jnp.int32),
-            default_left=jnp.zeros(L, dtype=bool),
-            is_categorical=jnp.zeros(L, dtype=bool),
-            cat_mask=jnp.zeros((L, B), dtype=bool),
-            cand_left_min=jnp.full(L, -jnp.inf, dtype=jnp.float32),
-            cand_left_max=jnp.full(L, jnp.inf, dtype=jnp.float32),
-            cand_right_min=jnp.full(L, -jnp.inf, dtype=jnp.float32),
-            cand_right_max=jnp.full(L, jnp.inf, dtype=jnp.float32),
-            left_sum_grad=zf(), left_sum_hess=zf(), left_count=zf(),
-            left_total_count=zf(), left_output=zf(), right_sum_grad=zf(),
-            right_sum_hess=zf(), right_count=zf(), right_total_count=zf(),
-            right_output=zf())
-        state = _store_info(state, 0, info, children_allowed)
+        state = make_root_state(gh, hist, leaf_of_row, info, self.L,
+                                self.F, self.B, children_allowed)
         return state, _record_at(state, 0)
 
-    def _step_impl(self, state: GrowState, leaf, new_leaf,
+    def _step_impl(self, bins, state: GrowState, leaf, new_leaf,
                    children_allowed, feature_mask):
         meta, params, B = self.meta, self.params, self.B
-        bins = self.bins
         f = state.feature[leaf]
         tbin = state.threshold_bin[leaf]
         dl = state.default_left[leaf]
@@ -176,11 +178,13 @@ class DataParallelTreeLearner:
         left_info = find_best_split(
             hist_left, state.left_sum_grad[leaf],
             state.left_sum_hess[leaf], lc, ltc, meta, params, feature_mask,
-            state.cand_left_min[leaf], state.cand_left_max[leaf])
+            state.cand_left_min[leaf], state.cand_left_max[leaf],
+            parent_output=state.left_output[leaf])
         right_info = find_best_split(
             hist_right, state.right_sum_grad[leaf],
             state.right_sum_hess[leaf], rc, rtc, meta, params, feature_mask,
-            state.cand_right_min[leaf], state.cand_right_max[leaf])
+            state.cand_right_min[leaf], state.cand_right_max[leaf],
+            parent_output=state.right_output[leaf])
 
         state = state._replace(leaf_of_row=leaf_of_row, hists=hists)
         state = _store_info(state, leaf, left_info, children_allowed)
@@ -192,7 +196,7 @@ class DataParallelTreeLearner:
     def _ensure_compiled(self):
         if self._root_fn is None:
             self._root_fn = jax.jit(self._root_impl)
-            self._step_fn = jax.jit(self._step_impl, donate_argnums=(0,))
+            self._step_fn = jax.jit(self._step_impl, donate_argnums=(1,))
 
     def _splittable(self, depth: int) -> bool:
         return self.max_depth <= 0 or depth < self.max_depth
@@ -213,43 +217,17 @@ class DataParallelTreeLearner:
         feature_mask = self._sample_features()
 
         tree = Tree(self.L)
-        state, rec = self._root_fn(gh, feature_mask, self._splittable(0))
+        state, rec = self._root_fn(self.bins, gh, feature_mask,
+                                   self._splittable(0))
         pending = jax.device_get(rec)
         for k in range(1, self.L):
-            leaf = int(pending.leaf)
-            if int(pending.feature) < 0 \
-                    or not np.isfinite(float(pending.gain)) \
-                    or float(pending.gain) <= 0.0:
+            if not record_is_valid(pending):
                 break
-            f = int(pending.feature)
-            tbin = int(pending.threshold_bin)
-            mapper = self.dataset.bin_mappers[f]
-            common = dict(
-                leaf=leaf, feature=self.dataset.real_feature_index(f),
-                feature_inner=f,
-                left_value=float(pending.left_output),
-                right_value=float(pending.right_output),
-                left_count=int(round(float(pending.left_count))),
-                right_count=int(round(float(pending.right_count))),
-                left_weight=float(pending.left_sum_hess),
-                right_weight=float(pending.right_sum_hess),
-                gain=float(pending.gain))
-            if bool(pending.is_categorical):
-                bin_mask = np.asarray(pending.cat_mask)
-                cats = [mapper.bin_2_categorical[b]
-                        for b in np.nonzero(bin_mask)[0]
-                        if b < len(mapper.bin_2_categorical)]
-                tree.split_categorical(
-                    cat_values=cats, bin_mask=bin_mask, **common)
-            else:
-                tree.split(
-                    threshold_bin=tbin,
-                    threshold_real=self.dataset.real_threshold(f, tbin),
-                    missing_type=mapper.missing_type,
-                    default_left=bool(pending.default_left), **common)
+            leaf = int(pending.leaf)
+            apply_split_record(tree, self.dataset, pending)
             children_allowed = self._splittable(int(tree.leaf_depth[leaf]))
             state, rec = self._step_fn(
-                state, jnp.int32(leaf), jnp.int32(k),
+                self.bins, state, jnp.int32(leaf), jnp.int32(k),
                 jnp.asarray(children_allowed), feature_mask)
             pending = jax.device_get(rec)
         return tree, state.leaf_of_row[:self.N]

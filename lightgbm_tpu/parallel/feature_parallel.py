@@ -41,10 +41,12 @@ class FeatureParallelTreeLearner(DataParallelTreeLearner):
         # pad the FEATURE axis to a devices multiple before sharding
         super().__init__(config, dataset, mesh, axis)
         n_dev = mesh.devices.size
-        N, F = dataset.bins.shape
+        bins_full = (dataset.feature_bins() if dataset.bundle is not None
+                     else dataset.bins)
+        N, F = bins_full.shape
         Fp = -(-F // n_dev) * n_dev
-        pad = np.zeros((N, Fp - F), dtype=dataset.bins.dtype)
-        bins_host = np.concatenate([dataset.bins, pad], axis=1)
+        pad = np.zeros((N, Fp - F), dtype=bins_full.dtype)
+        bins_host = np.concatenate([bins_full, pad], axis=1)
         # rows replicated, features sharded
         self.R = N
         self.F_pad = Fp
@@ -87,10 +89,10 @@ class FeatureParallelTreeLearner(DataParallelTreeLearner):
         mask[:real_f] = base
         return jax.device_put(jnp.asarray(mask), self.rep_sharding)
 
-    def _step_impl(self, state, leaf, new_leaf, children_allowed,
+    def _step_impl(self, bins, state, leaf, new_leaf, children_allowed,
                    feature_mask):
         # identical dataflow to the data-parallel step; the sharding of
-        # self.bins (features) makes the histogram feature-sharded and
-        # the partition column-gather cross-device
-        return super()._step_impl(state, leaf, new_leaf, children_allowed,
-                                  feature_mask)
+        # the bins argument (features) makes the histogram feature-sharded
+        # and the partition column-gather cross-device
+        return super()._step_impl(bins, state, leaf, new_leaf,
+                                  children_allowed, feature_mask)

@@ -93,7 +93,7 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
         mask = jnp.zeros(self.F, dtype=bool).at[voted].set(True)
         return mask & feature_mask
 
-    def _step_impl(self, state, leaf, new_leaf, children_allowed,
+    def _step_impl(self, bins, state, leaf, new_leaf, children_allowed,
                    feature_mask):
         """Same dataflow as the data-parallel step, with the best-split
         scan restricted to voted features. The full-histogram psum is
@@ -101,8 +101,8 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
         cross-device reduction (XLA still reduces the buffer, but the
         voted mask keeps the scan semantics of the reference; a DCN
         deployment would slice the buffer instead)."""
-        return super()._step_impl(state, leaf, new_leaf, children_allowed,
-                                  feature_mask)
+        return super()._step_impl(bins, state, leaf, new_leaf,
+                                  children_allowed, feature_mask)
 
     def train(self, grad, hess, bag=None):
         # vote once per tree on the root distribution (the reference

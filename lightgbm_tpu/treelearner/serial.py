@@ -5,8 +5,13 @@ TPU-native counterpart of the reference's SerialTreeLearner
 spirit, its CUDA whole-loop learner
 (src/treelearner/cuda/cuda_single_gpu_tree_learner.cpp:128): all heavy state
 — binned rows, gradients, per-leaf histograms, the row→leaf partition — is
-device-resident; the host only orchestrates the leaf loop and records the
-chosen splits into the host ``Tree``.
+device-resident; the host only orchestrates batches of split steps and
+records the chosen splits into the host ``Tree``.
+
+The binned matrix is a **traced argument** of every jitted function, never a
+closed-over constant: closing over it would embed the whole dataset into the
+HLO as a literal, making the compiled program scale with the data (at Higgs
+scale ~300 MB of program).
 
 XLA needs static shapes, so the two data-dependent quantities are handled as:
 
@@ -15,26 +20,28 @@ XLA needs static shapes, so the two data-dependent quantities are handled as:
   analogue of the reference's DataPartition::Split,
   src/treelearner/data_partition.hpp:21 / cuda_data_partition.cu:288).
 - **per-leaf row gather**: rows of the leaf to histogram are compacted with
-  ``jnp.nonzero(..., size=S)`` where the static size S is the smaller-child
-  count rounded up to a power of two; one jitted step function is compiled
-  per bucket size (~log2(N) variants, cached). Padding rows point at a
-  dummy row whose (grad, hess, count) are zero so they vanish from sums.
+  ``jnp.nonzero(..., size=S)`` where the static size S is a power of two
+  ≥ half the largest current leaf. Padding rows point at a dummy row whose
+  (grad, hess, count) are zero so they vanish from sums.
 
-Per split step (one device dispatch, one small host readback):
-  apply pending split -> partition update -> gather smaller child ->
-  histogram it -> sibling by subtraction (serial_tree_learner.cpp:421) ->
-  best-split scan for both children -> argmax over all leaf candidates ->
-  return the next winning split record to the host.
+Unlike the reference's CUDA learner (one host sync per split), split steps
+run in **batches**: a ``lax.fori_loop`` executes k split steps per device
+dispatch — the device itself argmaxes the next leaf to split, applies the
+split, histograms the smaller child, scans both children — and a buffer of
+k split records is read back per batch. S stays valid for a whole batch
+because the maximum leaf size never grows as splits proceed; k is derived
+from S (many steps per dispatch once gathers are small) so both the number
+of host round-trips per tree (~log₂ num_leaves + num_leaves/32) and the
+number of compiled variants (~log₂ N, keyed on S alone) stay small.
 
-The host loop mirrors the reference's ``Train`` loop: split the best leaf,
-stop when num_leaves is reached or no candidate has positive gain.
 max_depth gating follows BeforeFindBestSplit (serial_tree_learner.cpp:287):
 a leaf at depth d is splittable iff max_depth <= 0 or d < max_depth —
-enforced by zeroing candidate gains at record-creation time.
+enforced on device by zeroing candidate gains at record-creation time,
+using a device-resident per-leaf depth vector.
 """
 from __future__ import annotations
 
-from functools import partial
+import functools
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -44,17 +51,16 @@ import numpy as np
 from ..io.binning import MissingType
 from ..io.dataset import BinnedDataset
 from ..models.tree import Tree
-from ..ops.histogram import build_histogram, subtract_histogram
-from ..ops.split import (FeatureMeta, SplitInfo, SplitParams, find_best_split)
-from ..utils import log
+from ..ops.histogram import (build_histogram, subtract_histogram,
+                             unpack_bundle_histogram)
+from ..ops.split import (FeatureMeta, SplitInfo, SplitParams,
+                         calculate_leaf_output, find_best_split,
+                         make_rand_bins)
+from ..utils import log, next_pow2 as _next_pow2
 
 _NEG_INF = -jnp.inf
 _MIN_BUCKET = 256
-
-
-def _next_pow2(n: int) -> int:
-    n = max(int(n), 1)
-    return 1 << (n - 1).bit_length()
+_MAX_BATCH = 32
 
 
 class GrowState(NamedTuple):
@@ -63,11 +69,12 @@ class GrowState(NamedTuple):
     leaf_of_row: jnp.ndarray      # [R] i32 (R = N+1; last row is a dummy, -1)
     gh: jnp.ndarray               # [R, 4] f32 (grad, hess, in-bag, total=1)
     hists: jnp.ndarray            # [L, F, B, 4] f32
+    leaf_depth: jnp.ndarray       # [L] i32 — device-side max_depth gating
     # Per-leaf best-split candidates (SplitInfo fields, array-of-struct):
     gain: jnp.ndarray             # [L] f32, -inf when invalid
     feature: jnp.ndarray          # [L] i32
     threshold_bin: jnp.ndarray    # [L] i32
-    default_left: jnp.ndarray     # [L] bool
+    default_left: jnp.ndarray    # [L] bool
     is_categorical: jnp.ndarray   # [L] bool
     cat_mask: jnp.ndarray         # [L, B] bool — bins going left (cat)
     # monotone bounds each candidate's children would inherit
@@ -88,7 +95,7 @@ class GrowState(NamedTuple):
 
 
 class SplitRecord(NamedTuple):
-    """One winning split, read back to the host each step."""
+    """One winning split, read back to the host (per step or per batch)."""
     leaf: jnp.ndarray
     gain: jnp.ndarray
     feature: jnp.ndarray
@@ -127,36 +134,116 @@ def _record_at(state: GrowState, leaf) -> SplitRecord:
         right_output=state.right_output[leaf])
 
 
-def _store_info(state: GrowState, leaf, info: SplitInfo,
-                allowed) -> GrowState:
+def _empty_records(k: int, B: int) -> SplitRecord:
+    """[k]-shaped record buffers; feature = -1 marks never-written slots."""
+    zi = jnp.zeros(k, dtype=jnp.int32)
+    zf = jnp.zeros(k, dtype=jnp.float32)
+    zb = jnp.zeros(k, dtype=bool)
+    return SplitRecord(
+        leaf=zi, gain=jnp.full(k, _NEG_INF, dtype=jnp.float32),
+        feature=jnp.full(k, -1, dtype=jnp.int32), threshold_bin=zi,
+        default_left=zb, is_categorical=zb,
+        cat_mask=jnp.zeros((k, B), dtype=bool),
+        left_sum_grad=zf, left_sum_hess=zf, left_count=zf,
+        left_total_count=zf, left_output=zf,
+        right_sum_grad=zf, right_sum_hess=zf, right_count=zf,
+        right_total_count=zf, right_output=zf)
+
+
+def _store_info(state: GrowState, leaf, info: SplitInfo, allowed,
+                valid=True) -> GrowState:
+    """Write a leaf's candidate split; ``allowed`` zeroes the gain
+    (max_depth gating), ``valid`` guards the whole write (batched steps
+    after the no-more-splits point must leave state untouched)."""
+    def put(arr, new):
+        return arr.at[leaf].set(jnp.where(valid, new, arr[leaf]))
     return state._replace(
-        gain=state.gain.at[leaf].set(jnp.where(allowed, info.gain, _NEG_INF)),
-        feature=state.feature.at[leaf].set(info.feature),
-        threshold_bin=state.threshold_bin.at[leaf].set(info.threshold_bin),
-        default_left=state.default_left.at[leaf].set(info.default_left),
-        is_categorical=state.is_categorical.at[leaf].set(
-            info.is_categorical),
-        cat_mask=state.cat_mask.at[leaf].set(info.cat_mask),
-        cand_left_min=state.cand_left_min.at[leaf].set(
-            info.left_min_output),
-        cand_left_max=state.cand_left_max.at[leaf].set(
-            info.left_max_output),
-        cand_right_min=state.cand_right_min.at[leaf].set(
-            info.right_min_output),
-        cand_right_max=state.cand_right_max.at[leaf].set(
-            info.right_max_output),
-        left_sum_grad=state.left_sum_grad.at[leaf].set(info.left_sum_grad),
-        left_sum_hess=state.left_sum_hess.at[leaf].set(info.left_sum_hess),
-        left_count=state.left_count.at[leaf].set(info.left_count),
-        left_total_count=state.left_total_count.at[leaf].set(
-            info.left_total_count),
-        left_output=state.left_output.at[leaf].set(info.left_output),
-        right_sum_grad=state.right_sum_grad.at[leaf].set(info.right_sum_grad),
-        right_sum_hess=state.right_sum_hess.at[leaf].set(info.right_sum_hess),
-        right_count=state.right_count.at[leaf].set(info.right_count),
-        right_total_count=state.right_total_count.at[leaf].set(
-            info.right_total_count),
-        right_output=state.right_output.at[leaf].set(info.right_output))
+        gain=put(state.gain, jnp.where(allowed, info.gain, _NEG_INF)),
+        feature=put(state.feature, info.feature),
+        threshold_bin=put(state.threshold_bin, info.threshold_bin),
+        default_left=put(state.default_left, info.default_left),
+        is_categorical=put(state.is_categorical, info.is_categorical),
+        cat_mask=state.cat_mask.at[leaf].set(
+            jnp.where(valid, info.cat_mask, state.cat_mask[leaf])),
+        cand_left_min=put(state.cand_left_min, info.left_min_output),
+        cand_left_max=put(state.cand_left_max, info.left_max_output),
+        cand_right_min=put(state.cand_right_min, info.right_min_output),
+        cand_right_max=put(state.cand_right_max, info.right_max_output),
+        left_sum_grad=put(state.left_sum_grad, info.left_sum_grad),
+        left_sum_hess=put(state.left_sum_hess, info.left_sum_hess),
+        left_count=put(state.left_count, info.left_count),
+        left_total_count=put(state.left_total_count, info.left_total_count),
+        left_output=put(state.left_output, info.left_output),
+        right_sum_grad=put(state.right_sum_grad, info.right_sum_grad),
+        right_sum_hess=put(state.right_sum_hess, info.right_sum_hess),
+        right_count=put(state.right_count, info.right_count),
+        right_total_count=put(state.right_total_count,
+                              info.right_total_count),
+        right_output=put(state.right_output, info.right_output))
+
+
+def make_root_state(gh, hist, leaf_of_row, info, L: int, F: int, B: int,
+                    children_allowed) -> GrowState:
+    """Initial GrowState after the root histogram+scan (shared by the
+    serial and mesh-parallel learners)."""
+    zf = lambda: jnp.zeros(L, dtype=jnp.float32)
+    state = GrowState(
+        leaf_of_row=leaf_of_row, gh=gh,
+        hists=jnp.zeros((L, F, B, 4), dtype=jnp.float32).at[0].set(hist),
+        leaf_depth=jnp.zeros(L, dtype=jnp.int32),
+        gain=jnp.full(L, _NEG_INF, dtype=jnp.float32),
+        feature=jnp.full(L, -1, dtype=jnp.int32),
+        threshold_bin=jnp.zeros(L, dtype=jnp.int32),
+        default_left=jnp.zeros(L, dtype=bool),
+        is_categorical=jnp.zeros(L, dtype=bool),
+        cat_mask=jnp.zeros((L, B), dtype=bool),
+        cand_left_min=jnp.full(L, -jnp.inf, dtype=jnp.float32),
+        cand_left_max=jnp.full(L, jnp.inf, dtype=jnp.float32),
+        cand_right_min=jnp.full(L, -jnp.inf, dtype=jnp.float32),
+        cand_right_max=jnp.full(L, jnp.inf, dtype=jnp.float32),
+        left_sum_grad=zf(), left_sum_hess=zf(), left_count=zf(),
+        left_total_count=zf(), left_output=zf(), right_sum_grad=zf(),
+        right_sum_hess=zf(), right_count=zf(), right_total_count=zf(),
+        right_output=zf())
+    return _store_info(state, 0, info, children_allowed)
+
+
+def record_is_valid(rec) -> bool:
+    """Host-side check of a read-back split record."""
+    return (int(rec.feature) >= 0 and np.isfinite(float(rec.gain))
+            and float(rec.gain) > 0.0)
+
+
+def apply_split_record(tree: Tree, dataset: BinnedDataset, rec) -> None:
+    """Replay one device split record into the host Tree (reference:
+    the Tree::Split call inside SerialTreeLearner::Split,
+    serial_tree_learner.cpp:593)."""
+    leaf = int(rec.leaf)
+    f = int(rec.feature)
+    tbin = int(rec.threshold_bin)
+    mapper = dataset.bin_mappers[f]
+    common = dict(
+        leaf=leaf, feature=dataset.real_feature_index(f),
+        feature_inner=f,
+        left_value=float(rec.left_output),
+        right_value=float(rec.right_output),
+        left_count=int(round(float(rec.left_count))),
+        right_count=int(round(float(rec.right_count))),
+        left_weight=float(rec.left_sum_hess),
+        right_weight=float(rec.right_sum_hess),
+        gain=float(rec.gain))
+    if bool(rec.is_categorical):
+        bin_mask = np.asarray(rec.cat_mask)
+        cats = [mapper.bin_2_categorical[b]
+                for b in np.nonzero(bin_mask)[0]
+                if b < len(mapper.bin_2_categorical)]
+        tree.split_categorical(cat_values=cats, bin_mask=bin_mask, **common)
+    else:
+        tree.split(
+            threshold_bin=tbin,
+            threshold_real=dataset.real_threshold(f, tbin),
+            missing_type=mapper.missing_type,
+            default_left=bool(rec.default_left), **common)
 
 
 def _go_left_by_bin(col: jnp.ndarray, tbin, default_left,
@@ -175,43 +262,330 @@ def _go_left_by_bin(col: jnp.ndarray, tbin, default_left,
     return gl
 
 
+# ----------------------------------------------------------------------
+# Jitted step functions. Module-level + lru_cache so the compiled
+# executables are shared across learner instances (every test / Booster
+# builds a new learner; per-instance closures would recompile the same
+# graphs). All data — bins, meta, params — is traced arguments; only
+# shapes and structural flags are static.
+# ----------------------------------------------------------------------
+
+def _maybe_rand_bins(extra_trees: bool, rand_seed, node_id, meta, params):
+    """Per-node extra_trees random thresholds, or None."""
+    if not extra_trees:
+        return None
+    key = jax.random.fold_in(jax.random.PRNGKey(rand_seed), node_id)
+    return make_rand_bins(key, meta, params)
+
+
+class BundleTables(NamedTuple):
+    """Device-resident EFB tables (io/efb.py BundleLayout mirror).
+    ``member[g, b]``/``unmap[g, b]`` route a bundle bin back to its
+    owning feature and original bin; ``gidx_*`` gather the bundle
+    histogram into per-feature histograms; zero rows are reconstructed
+    for ``zero_fix`` features."""
+    group_of: jnp.ndarray       # [Fp] i32
+    member: jnp.ndarray         # [Gp, Bg] i32
+    unmap: jnp.ndarray          # [Gp, Bg] i32
+    gidx_g: jnp.ndarray         # [Fp, B] i32 (-1 = empty)
+    gidx_b: jnp.ndarray         # [Fp, B] i32
+    zero_fix: jnp.ndarray       # [Fp] bool
+
+
+def _leaf_histogram(bins, gh, meta, btab, *, B: int, Bg: int,
+                    bundled: bool, totals=None):
+    """Histogram of (a subset of) rows → per-feature [Fp, B, 4].
+    Bundled mode histograms the [*, G] bundle matrix at Bg bins then
+    unpacks (totals = the leaf's channel sums for zero-bin rows)."""
+    if not bundled:
+        return build_histogram(bins, gh, B)
+    bhist = build_histogram(bins, gh, Bg)
+    if totals is None:
+        totals = jnp.sum(gh, axis=0)
+    return unpack_bundle_histogram(bhist, btab.gidx_g, btab.gidx_b,
+                                   btab.zero_fix, meta.zero_bin, totals)
+
+
+def _partition_col(bins, f, meta, btab, bundled: bool):
+    """The split feature's ORIGINAL bin value per row (unbundling via the
+    member/unmap LUTs when bundled; identity otherwise)."""
+    if not bundled:
+        return jnp.take(bins, f, axis=1).astype(jnp.int32)
+    g = btab.group_of[f]
+    raw = jnp.take(bins, g, axis=1).astype(jnp.int32)
+    owner = btab.member[g][raw]
+    return jnp.where(owner == f, btab.unmap[g][raw], meta.zero_bin[f])
+
+
+def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
+                valid, mask_left, mask_right, meta, params, btab, *,
+                S: int, B: int, Bg: int, bundled: bool, max_depth: int,
+                extra_trees: bool, children_allowed=None,
+                rand_seed=0) -> GrowState:
+    """Apply one split (already chosen: ``rec`` at ``leaf``) and scan both
+    children. Shared by the per-split and batched paths.
+    ``children_allowed`` None means: derive from device leaf_depth."""
+    R = bins.shape[0]
+    f = jnp.maximum(rec.feature, 0)
+    col = _partition_col(bins, f, meta, btab, bundled)
+    gl = _go_left_by_bin(col, rec.threshold_bin, rec.default_left,
+                         meta.missing_type[f], meta.num_bin[f] - 1,
+                         meta.zero_bin[f], rec.is_categorical,
+                         rec.cat_mask)
+    on_leaf = state.leaf_of_row == leaf
+    leaf_of_row = jnp.where(valid & on_leaf & ~gl, new_leaf,
+                            state.leaf_of_row)
+
+    smaller_is_left = rec.left_total_count <= rec.right_total_count
+    small_id = jnp.where(smaller_is_left, leaf, new_leaf)
+    (idx,) = jnp.nonzero(leaf_of_row == small_id, size=S,
+                         fill_value=R - 1)
+    small_totals = jnp.stack([
+        jnp.where(smaller_is_left, rec.left_sum_grad, rec.right_sum_grad),
+        jnp.where(smaller_is_left, rec.left_sum_hess, rec.right_sum_hess),
+        jnp.where(smaller_is_left, rec.left_count, rec.right_count),
+        jnp.where(smaller_is_left, rec.left_total_count,
+                  rec.right_total_count)])
+    hist_small = _leaf_histogram(bins[idx], state.gh[idx], meta, btab,
+                                 B=B, Bg=Bg, bundled=bundled,
+                                 totals=small_totals)
+    hist_large = subtract_histogram(state.hists[leaf], hist_small)
+    hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
+    hist_right = jnp.where(smaller_is_left, hist_large, hist_small)
+    hists = state.hists \
+        .at[leaf].set(jnp.where(valid, hist_left, state.hists[leaf])) \
+        .at[new_leaf].set(
+            jnp.where(valid, hist_right, state.hists[new_leaf]))
+
+    child_depth = state.leaf_depth[leaf] + 1
+    leaf_depth = state.leaf_depth \
+        .at[leaf].set(jnp.where(valid, child_depth,
+                                state.leaf_depth[leaf])) \
+        .at[new_leaf].set(jnp.where(valid, child_depth,
+                                    state.leaf_depth[new_leaf]))
+    if children_allowed is None:
+        children_allowed = (max_depth <= 0) | (child_depth < max_depth)
+
+    left_info = find_best_split(
+        hist_left, rec.left_sum_grad, rec.left_sum_hess,
+        rec.left_count, rec.left_total_count, meta, params,
+        mask_left, state.cand_left_min[leaf],
+        state.cand_left_max[leaf],
+        parent_output=rec.left_output,
+        rand_bins=_maybe_rand_bins(extra_trees, rand_seed, 2 * new_leaf,
+                                   meta, params))
+    right_info = find_best_split(
+        hist_right, rec.right_sum_grad, rec.right_sum_hess,
+        rec.right_count, rec.right_total_count, meta, params,
+        mask_right, state.cand_right_min[leaf],
+        state.cand_right_max[leaf],
+        parent_output=rec.right_output,
+        rand_bins=_maybe_rand_bins(extra_trees, rand_seed,
+                                   2 * new_leaf + 1, meta, params))
+
+    state = state._replace(leaf_of_row=leaf_of_row, hists=hists,
+                           leaf_depth=leaf_depth)
+    state = _store_info(state, leaf, left_info, children_allowed, valid)
+    state = _store_info(state, new_leaf, right_info, children_allowed,
+                        valid)
+    return state
+
+
+@functools.lru_cache(maxsize=None)
+def _root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
+                    extra_trees: bool):
+    def root(bins, gh, leaf_of_row0, feature_mask, children_allowed,
+             rand_seed, meta, params, btab):
+        F = meta.num_bin.shape[0]
+        sums = jnp.sum(gh, axis=0)
+        hist = _leaf_histogram(bins, gh, meta, btab, B=B, Bg=Bg,
+                               bundled=bundled, totals=sums)
+        # root "parent" output: its own unsmoothed output (reference:
+        # SerialTreeLearner::GetParentOutput, serial_tree_learner.cpp:786)
+        parent_out = calculate_leaf_output(sums[0], sums[1], params)
+        info = find_best_split(
+            hist, sums[0], sums[1], sums[2], sums[3], meta, params,
+            feature_mask, parent_output=parent_out,
+            rand_bins=_maybe_rand_bins(extra_trees, rand_seed, 0, meta,
+                                       params))
+        state = make_root_state(gh, hist, leaf_of_row0, info, L, F, B,
+                                children_allowed)
+        return state, _record_at(state, 0)
+
+    return jax.jit(root)
+
+
+@functools.lru_cache(maxsize=None)
+def _step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
+                    extra_trees: bool):
+    """Per-split step (host chooses the leaf): used when per-node feature
+    masks (interaction constraints / bynode sampling) force a host
+    round-trip per split."""
+    def step(bins, state: GrowState, leaf, new_leaf, children_allowed,
+             mask_left, mask_right, rand_seed, meta, params, btab):
+        rec = _record_at(state, leaf)
+        state = _split_body(bins, state, rec, leaf, new_leaf,
+                            jnp.asarray(True), mask_left, mask_right,
+                            meta, params, btab, S=S, B=B, Bg=Bg,
+                            bundled=bundled, max_depth=0,
+                            extra_trees=extra_trees,
+                            children_allowed=children_allowed,
+                            rand_seed=rand_seed)
+        best = jnp.argmax(state.gain).astype(jnp.int32)
+        return state, _record_at(state, best)
+
+    return jax.jit(step, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=None)
+def _forced_fn_cached(S: int, B: int, Bg: int, bundled: bool,
+                      extra_trees: bool):
+    """Forced split of a given (feature, threshold-bin) on a leaf
+    (reference: SerialTreeLearner::ForceSplits,
+    serial_tree_learner.cpp:451): the split record is built from the
+    leaf's stored histogram instead of a best-gain scan, then applied
+    through the normal split body so the children get their candidate
+    scans."""
+    def forced(bins, state: GrowState, leaf, new_leaf, f, tbin,
+               children_allowed, feature_mask, rand_seed, meta, params,
+               btab):
+        row = state.hists[leaf][f]                   # [B, 4]
+        cum = jnp.cumsum(row, axis=0)
+        tot = cum[-1]
+        left = cum[tbin]
+        right = tot - left
+        out_l = calculate_leaf_output(left[0], left[1], params)
+        out_r = calculate_leaf_output(right[0], right[1], params)
+        # default_left must match where the cumsum put the missing rows:
+        # ZERO rows sit in the zero bin (left iff zero_bin <= tbin), NaN
+        # rows in the last bin (left iff tbin reaches it) — same
+        # convention as find_best_split's natural placement
+        dl = jnp.where(meta.missing_type[f] == MissingType.NAN,
+                       tbin >= meta.num_bin[f] - 1,
+                       meta.zero_bin[f] <= tbin)
+        rec = SplitRecord(
+            leaf=leaf, gain=jnp.float32(0.0), feature=f,
+            threshold_bin=tbin, default_left=dl,
+            is_categorical=jnp.asarray(False),
+            cat_mask=jnp.zeros(B, dtype=bool),
+            left_sum_grad=left[0], left_sum_hess=left[1],
+            left_count=left[2], left_total_count=left[3],
+            left_output=out_l,
+            right_sum_grad=right[0], right_sum_hess=right[1],
+            right_count=right[2], right_total_count=right[3],
+            right_output=out_r)
+        ok = (left[3] > 0.5) & (right[3] > 0.5)
+        state = _split_body(bins, state, rec, leaf, new_leaf, ok,
+                            feature_mask, feature_mask, meta, params,
+                            btab, S=S, B=B, Bg=Bg, bundled=bundled,
+                            max_depth=0, extra_trees=extra_trees,
+                            children_allowed=children_allowed,
+                            rand_seed=rand_seed)
+        return state, rec, ok
+
+    return jax.jit(forced, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=None)
+def _batch_fn_cached(S: int, kb: int, B: int, Bg: int, bundled: bool,
+                     max_depth: int, extra_trees: bool):
+    """Batched split steps: one dispatch runs kb splits, the device
+    picking the best leaf each step (the argmax the reference does on host
+    at serial_tree_learner.cpp:194). Records of the applied splits are
+    written to [kb] buffers and read back once."""
+    def batch(bins, state: GrowState, start_leaf, max_splits,
+              feature_mask, rand_seed, meta, params, btab):
+        def body(i, carry):
+            state, recs = carry
+            best = jnp.argmax(state.gain).astype(jnp.int32)
+            rec = _record_at(state, best)
+            valid = ((rec.feature >= 0) & jnp.isfinite(rec.gain)
+                     & (rec.gain > 0.0) & (i < max_splits))
+            recs = jax.tree_util.tree_map(
+                lambda buf, v: buf.at[i].set(v), recs, rec)
+            new_leaf = (start_leaf + i).astype(jnp.int32)
+            state = _split_body(bins, state, rec, best, new_leaf, valid,
+                                feature_mask, feature_mask, meta, params,
+                                btab, S=S, B=B, Bg=Bg, bundled=bundled,
+                                max_depth=max_depth,
+                                extra_trees=extra_trees,
+                                rand_seed=rand_seed)
+            return state, recs
+
+        state, recs = jax.lax.fori_loop(
+            0, kb, body, (state, _empty_records(kb, B)))
+        return state, recs
+
+    return jax.jit(batch, donate_argnums=(1,))
+
+
 class SerialTreeLearner:
     """Leaf-wise grower over a device-resident binned dataset."""
 
     def __init__(self, config, dataset: BinnedDataset):
         self.config = config
         self.dataset = dataset
-        N, F = dataset.bins.shape
+        N = dataset.num_data
+        F = dataset.num_features  # logical features (≠ bundle columns)
         if F == 0:
             log.fatal("Cannot train without features")
         self.N, self.F = N, F
-        self.B = max(int(dataset.max_num_bin), 2)
+        # pad the histogram width to a power of two: the actual max bin
+        # count is data-dependent (e.g. 251 vs 247), and a canonical B
+        # lets datasets with similar binning share compiled step variants
+        self.B = _next_pow2(max(int(dataset.max_num_bin), 2))
         self.L = int(config.num_leaves)
         self.max_depth = int(config.max_depth)
-        # dummy row N: bins 0, gh 0, leaf -1
-        pad = np.zeros((1, F), dtype=dataset.bins.dtype)
-        self.bins = jnp.asarray(np.concatenate([dataset.bins, pad], axis=0))
-        self.meta = FeatureMeta.from_dataset(
-            dataset, int(config.max_cat_to_onehot))
+        # Pad rows to a 4096 multiple (at least one dummy row) and
+        # feature/bundle columns to an 8 multiple: pad rows carry gh 0 /
+        # leaf -1 so they vanish from every sum, pad features are trivial
+        # (num_bin 1), and the canonical shapes share compiled step
+        # variants across datasets. The dummy rows double as the
+        # nonzero-gather fill target.
+        self.R = -(-(N + 1) // 4096) * 4096
+        self.Fp = -(-F // 8) * 8
+        self._bundled = dataset.bundle is not None
+        ncols = (dataset.bundle.num_groups if self._bundled else F)
+        self.Gp = -(-ncols // 8) * 8
+        bins_host = np.zeros((self.R, self.Gp if self._bundled
+                              else self.Fp), dtype=dataset.bins.dtype)
+        bins_host[:N, :ncols if self._bundled else F] = dataset.bins
+        self.bins = jnp.asarray(bins_host)
+        self._leaf_of_row0 = jnp.concatenate([
+            jnp.zeros(N, dtype=jnp.int32),
+            jnp.full((self.R - N,), -1, dtype=jnp.int32)])
+        from ..ops.split import pad_feature_meta
+        self.meta = pad_feature_meta(
+            FeatureMeta.from_dataset(dataset,
+                                     int(config.max_cat_to_onehot)),
+            self.Fp - F)
+        self._build_bundle_tables(dataset)
         self.params = SplitParams.from_config(config)
         self._ff_rng = np.random.RandomState(config.feature_fraction_seed)
         self._resolve_constraints()
-        self._step_cache = {}
-        self._root_fn = jax.jit(self._root_impl)
         self._max_bucket = _next_pow2(N)
+        # extra_trees (config.h:368): random single-threshold candidates,
+        # seeded per tree (host counter) and per node (device fold-in)
+        self._extra_trees = bool(config.extra_trees)
+        self._extra_seed = int(config.extra_seed)
+        self._tree_idx = 0
+        self._root_fn = _root_fn_cached(self.L, self.B, self.Bg,
+                                        self._bundled, self._extra_trees)
+        self._forced = self._load_forced_splits(config)
 
     # ------------------------------------------------------------------
     def _sample_features(self) -> jnp.ndarray:
         """Per-tree column sampling (reference: ColSampler,
         src/treelearner/col_sampler.hpp:20)."""
         ff = float(self.config.feature_fraction)
-        mask = np.ones(self.F, dtype=bool)
+        mask = np.zeros(self.Fp, dtype=bool)
+        mask[:self.F] = True
         if 0.0 < ff < 1.0:
             k = max(1, int(round(self.F * ff)))
             mask[:] = False
             mask[self._ff_rng.choice(self.F, k, replace=False)] = True
         if self._constraint_groups is not None:
-            allowed = np.zeros(self.F, dtype=bool)
+            allowed = np.zeros(self.Fp, dtype=bool)
             for grp in self._constraint_groups:
                 allowed[list(grp)] = True
             mask &= allowed
@@ -242,14 +616,14 @@ class SerialTreeLearner:
         feature-path, plus feature_fraction_bynode sampling."""
         mask = None
         if self._constraint_groups is not None:
-            allowed = np.zeros(self.F, dtype=bool)
+            allowed = np.zeros(self.Fp, dtype=bool)
             for grp in self._constraint_groups:
                 if path_features <= grp:
                     allowed[list(grp)] = True
             mask = allowed
         ffb = float(self.config.feature_fraction_bynode)
         if 0.0 < ffb < 1.0:
-            m2 = np.zeros(self.F, dtype=bool)
+            m2 = np.zeros(self.Fp, dtype=bool)
             k = max(1, int(round(self.F * ffb)))
             m2[self._ff_rng.choice(self.F, k, replace=False)] = True
             mask = m2 if mask is None else (mask & m2)
@@ -258,103 +632,118 @@ class SerialTreeLearner:
         return tree_mask & jnp.asarray(mask)
 
     # ------------------------------------------------------------------
-    def _root_impl(self, gh: jnp.ndarray, feature_mask: jnp.ndarray,
-                   children_allowed) -> Tuple[GrowState, SplitRecord]:
-        hist = build_histogram(self.bins, gh, self.B)
-        sums = jnp.sum(gh, axis=0)
-        info = find_best_split(hist, sums[0], sums[1], sums[2], sums[3],
-                               self.meta, self.params, feature_mask)
-        L, F, B = self.L, self.F, self.B
-        leaf_of_row = jnp.concatenate([
-            jnp.zeros(self.N, dtype=jnp.int32),
-            jnp.full((1,), -1, dtype=jnp.int32)])
-        zf = lambda: jnp.zeros(L, dtype=jnp.float32)
-        state = GrowState(
-            leaf_of_row=leaf_of_row, gh=gh,
-            hists=jnp.zeros((L, F, B, 4), dtype=jnp.float32).at[0].set(hist),
-            gain=jnp.full(L, _NEG_INF, dtype=jnp.float32),
-            feature=jnp.full(L, -1, dtype=jnp.int32),
-            threshold_bin=jnp.zeros(L, dtype=jnp.int32),
-            default_left=jnp.zeros(L, dtype=bool),
-            is_categorical=jnp.zeros(L, dtype=bool),
-            cat_mask=jnp.zeros((L, B), dtype=bool),
-            cand_left_min=jnp.full(L, -jnp.inf, dtype=jnp.float32),
-            cand_left_max=jnp.full(L, jnp.inf, dtype=jnp.float32),
-            cand_right_min=jnp.full(L, -jnp.inf, dtype=jnp.float32),
-            cand_right_max=jnp.full(L, jnp.inf, dtype=jnp.float32),
-            left_sum_grad=zf(), left_sum_hess=zf(), left_count=zf(),
-            left_total_count=zf(), left_output=zf(), right_sum_grad=zf(),
-            right_sum_hess=zf(), right_count=zf(), right_total_count=zf(),
-            right_output=zf())
-        state = _store_info(state, 0, info, children_allowed)
-        return state, _record_at(state, 0)
-
-    # ------------------------------------------------------------------
-    def _make_step(self, S: int):
-        meta, params, B = self.meta, self.params, self.B
-        bins = self.bins
-        R = self.N + 1
-
-        def step(state: GrowState, leaf, new_leaf, children_allowed,
-                 mask_left, mask_right):
-            f = state.feature[leaf]
-            tbin = state.threshold_bin[leaf]
-            dl = state.default_left[leaf]
-            col = jnp.take(bins, f, axis=1).astype(jnp.int32)
-            gl = _go_left_by_bin(col, tbin, dl, meta.missing_type[f],
-                                 meta.num_bin[f] - 1, meta.zero_bin[f],
-                                 state.is_categorical[leaf],
-                                 state.cat_mask[leaf])
-            on_leaf = state.leaf_of_row == leaf
-            leaf_of_row = jnp.where(on_leaf & ~gl, new_leaf,
-                                    state.leaf_of_row)
-
-            lc, rc = state.left_count[leaf], state.right_count[leaf]
-            ltc, rtc = (state.left_total_count[leaf],
-                        state.right_total_count[leaf])
-            smaller_is_left = ltc <= rtc
-            small_id = jnp.where(smaller_is_left, leaf, new_leaf)
-            (idx,) = jnp.nonzero(leaf_of_row == small_id, size=S,
-                                 fill_value=R - 1)
-            hist_small = build_histogram(bins[idx], state.gh[idx], B)
-            hist_large = subtract_histogram(state.hists[leaf], hist_small)
-            hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
-            hist_right = jnp.where(smaller_is_left, hist_large, hist_small)
-            hists = state.hists.at[leaf].set(hist_left) \
-                               .at[new_leaf].set(hist_right)
-
-            left_info = find_best_split(
-                hist_left, state.left_sum_grad[leaf],
-                state.left_sum_hess[leaf], lc, ltc, meta, params,
-                mask_left, state.cand_left_min[leaf],
-                state.cand_left_max[leaf])
-            right_info = find_best_split(
-                hist_right, state.right_sum_grad[leaf],
-                state.right_sum_hess[leaf], rc, rtc, meta, params,
-                mask_right, state.cand_right_min[leaf],
-                state.cand_right_max[leaf])
-
-            state = state._replace(leaf_of_row=leaf_of_row, hists=hists)
-            state = _store_info(state, leaf, left_info, children_allowed)
-            state = _store_info(state, new_leaf, right_info, children_allowed)
-            best = jnp.argmax(state.gain).astype(jnp.int32)
-            return state, _record_at(state, best)
-
-        return jax.jit(step, donate_argnums=(0,))
+    def _build_bundle_tables(self, dataset: BinnedDataset) -> None:
+        """Device EFB tables (or a dummy scalar when unbundled)."""
+        if not self._bundled:
+            self.Bg = 0
+            self._btab = jnp.int32(0)
+            return
+        lay = dataset.bundle
+        G = lay.num_groups
+        self.Bg = _next_pow2(max(lay.num_bundled_bins, 2))
+        member = np.full((self.Gp, self.Bg), -1, dtype=np.int32)
+        member[:G, :lay.member.shape[1]] = lay.member
+        unmap = np.zeros((self.Gp, self.Bg), dtype=np.int32)
+        unmap[:G, :lay.unmap.shape[1]] = lay.unmap
+        group_of = np.zeros(self.Fp, dtype=np.int32)
+        group_of[:self.F] = lay.group_of
+        gidx_g = np.full((self.Fp, self.B), -1, dtype=np.int32)
+        gidx_b = np.zeros((self.Fp, self.B), dtype=np.int32)
+        gidx_g[:self.F, :lay.gidx_g.shape[1]] = lay.gidx_g
+        gidx_b[:self.F, :lay.gidx_b.shape[1]] = lay.gidx_b
+        zero_fix = np.zeros(self.Fp, dtype=bool)
+        zero_fix[:self.F] = lay.needs_zero_fix
+        self._btab = BundleTables(
+            group_of=jnp.asarray(group_of), member=jnp.asarray(member),
+            unmap=jnp.asarray(unmap), gidx_g=jnp.asarray(gidx_g),
+            gidx_b=jnp.asarray(gidx_b), zero_fix=jnp.asarray(zero_fix))
 
     def _step_fn(self, S: int):
-        if S not in self._step_cache:
-            self._step_cache[S] = self._make_step(S)
-        return self._step_cache[S]
+        return _step_fn_cached(S, self.B, self.Bg, self._bundled,
+                               self._extra_trees)
+
+    def _batch_fn(self, S: int):
+        kb = self._batch_k(S)
+        return (_batch_fn_cached(S, kb, self.B, self.Bg, self._bundled,
+                                 self.max_depth, self._extra_trees), kb)
+
+    def _batch_k(self, S: int) -> int:
+        """Steps per dispatch: aim for ~2R gathered rows per batch so early
+        (large-S) batches stay short while deep-tree batches amortize the
+        host round-trip over many cheap steps. Derived from the padded row
+        count R (not N) so the (S, kb) pair — and thus the compiled batch
+        variant — is shared across datasets of similar size."""
+        return int(np.clip((2 * self.R) // max(S, 1), 1, _MAX_BATCH))
 
     def _bucket(self, count: float) -> int:
-        # +1 margin: counts travel as f32 sums and may round down for very
-        # large leaves. The floor caps the number of compiled step variants
-        # at ~log2(N) - 8.
-        return min(max(_next_pow2(int(count) + 1), _MIN_BUCKET),
+        # Small data (one pad block): a single canonical gather size —
+        # every small dataset then shares one compiled batch variant, and
+        # the extra gathered rows are noise at this scale.
+        if self.R <= 4096:
+            return self.R // 2
+        # +16 margin: counts travel as f32 sums and may round for very
+        # large leaves. The floor caps compiled variants at ~log2(N) - 8.
+        return min(max(_next_pow2(int(count) + 16), _MIN_BUCKET),
                    self._max_bucket)
 
     # ------------------------------------------------------------------
+    def _load_forced_splits(self, config):
+        """Parse forcedsplits_filename JSON (reference: forced splits
+        config.h:518, format {"feature": i, "threshold": v,
+        "left": {...}, "right": {...}})."""
+        if not config.forcedsplits_filename:
+            return None
+        import json
+        try:
+            with open(config.forcedsplits_filename) as fh:
+                return json.load(fh)
+        except (OSError, ValueError) as e:
+            log.warning("Cannot load forced splits from %s: %s"
+                        % (config.forcedsplits_filename, e))
+            return None
+
+    def _apply_forced_splits(self, tree: Tree, state: GrowState,
+                             feature_mask, rand_seed, leaf_total):
+        """Apply the forced-split tree breadth-first before best-gain
+        growth (reference: SerialTreeLearner::ForceSplits,
+        serial_tree_learner.cpp:451). Returns (state, next_leaf)."""
+        next_leaf = 1
+        queue = [(0, self._forced)]
+        while queue and next_leaf < self.L:
+            leaf, spec = queue.pop(0)
+            if not isinstance(spec, dict) or "feature" not in spec:
+                continue
+            inner = self.dataset.inner_feature_index(int(spec["feature"]))
+            if inner < 0:
+                continue
+            mapper = self.dataset.bin_mappers[inner]
+            tbin = int(mapper.value_to_bin(
+                np.asarray([float(spec.get("threshold", 0.0))]))[0])
+            M = max(leaf_total.values())
+            S = self._bucket(M / 2)
+            fn = _forced_fn_cached(S, self.B, self.Bg, self._bundled,
+                                   self._extra_trees)
+            allowed = self._splittable(int(tree.leaf_depth[leaf]) + 1)
+            state, rec, ok = fn(self.bins, state, jnp.int32(leaf),
+                                jnp.int32(next_leaf), jnp.int32(inner),
+                                jnp.int32(tbin), jnp.asarray(allowed),
+                                feature_mask, rand_seed, self.meta,
+                                self.params, self._btab)
+            if not bool(jax.device_get(ok)):
+                log.warning("Forced split on feature %d leaves an empty "
+                            "side; skipped" % int(spec["feature"]))
+                continue
+            r = jax.device_get(rec)
+            apply_split_record(tree, self.dataset, r)
+            leaf_total[leaf] = float(r.left_total_count)
+            leaf_total[next_leaf] = float(r.right_total_count)
+            if "left" in spec:
+                queue.append((leaf, spec["left"]))
+            if "right" in spec:
+                queue.append((next_leaf, spec["right"]))
+            next_leaf += 1
+        return state, next_leaf
+
     def _splittable(self, depth: int) -> bool:
         return self.max_depth <= 0 or depth < self.max_depth
 
@@ -370,60 +759,90 @@ class SerialTreeLearner:
         gh = jnp.stack([grad * ind, hess * ind, ind,
                         jnp.ones(self.N, dtype=jnp.float32)], axis=1)
         gh = jnp.concatenate(
-            [gh, jnp.zeros((1, 4), dtype=jnp.float32)], axis=0)
+            [gh, jnp.zeros((self.R - self.N, 4), dtype=jnp.float32)],
+            axis=0)
         feature_mask = self._sample_features()
 
         tree = Tree(self.L)
-        state, rec = self._root_fn(gh, feature_mask, self._splittable(0))
-        pending = jax.device_get(rec)
-        # per-leaf feature path (for interaction constraints / bynode)
-        paths = {0: frozenset()}
+        # per-tree extra_trees seed (traced, so no retrace per tree)
+        self._tree_idx += 1
+        rand_seed = jnp.int32(
+            (self._extra_seed + 7919 * self._tree_idx) & 0x7FFFFFFF)
+        state, rec = self._root_fn(self.bins, gh, self._leaf_of_row0,
+                                   feature_mask, self._splittable(0),
+                                   rand_seed, self.meta, self.params,
+                                   self._btab)
+        leaf_total = {0: float(self.N)}
+        next_leaf = 1
+        if self._forced is not None:
+            state, next_leaf = self._apply_forced_splits(
+                tree, state, feature_mask, rand_seed, leaf_total)
         per_node = (self._constraint_groups is not None
                     or 0.0 < float(self.config.feature_fraction_bynode)
                     < 1.0)
-        for k in range(1, self.L):
-            leaf = int(pending.leaf)
-            if int(pending.feature) < 0 or not np.isfinite(float(pending.gain)) \
-                    or float(pending.gain) <= 0.0:
+        if per_node and self._forced is not None:
+            log.warning("forced splits combined with per-node feature "
+                        "masks run without the per-node masks")
+        if per_node and self._forced is None:
+            state = self._train_stepwise(tree, state, rec, feature_mask,
+                                         rand_seed)
+        else:
+            state = self._train_batched(tree, state, feature_mask,
+                                        rand_seed, leaf_total, next_leaf)
+        return tree, state.leaf_of_row[:self.N]
+
+    # ------------------------------------------------------------------
+    def _train_batched(self, tree: Tree, state: GrowState,
+                       feature_mask, rand_seed, leaf_total=None,
+                       next_leaf: int = 1) -> GrowState:
+        if leaf_total is None:
+            leaf_total = {0: float(self.N)}
+        while next_leaf < self.L:
+            M = max(leaf_total.values())
+            S = self._bucket(M / 2)
+            fn, kb = self._batch_fn(S)
+            max_splits = min(kb, self.L - next_leaf)
+            state, recs = fn(self.bins, state, jnp.int32(next_leaf),
+                             jnp.int32(max_splits), feature_mask,
+                             rand_seed, self.meta, self.params,
+                             self._btab)
+            recs_h = jax.device_get(recs)
+            stop = False
+            for i in range(max_splits):
+                r = jax.tree_util.tree_map(lambda a: a[i], recs_h)
+                if not record_is_valid(r):
+                    stop = True
+                    break
+                apply_split_record(tree, self.dataset, r)
+                leaf_total[int(r.leaf)] = float(r.left_total_count)
+                leaf_total[next_leaf] = float(r.right_total_count)
+                next_leaf += 1
+            if stop:
                 break
+        return state
+
+    def _train_stepwise(self, tree: Tree, state: GrowState, rec,
+                        feature_mask, rand_seed=0) -> GrowState:
+        """One host round-trip per split — needed when per-node feature
+        masks depend on the host-side feature path."""
+        pending = jax.device_get(rec)
+        paths = {0: frozenset()}
+        for k in range(1, self.L):
+            if not record_is_valid(pending):
+                break
+            leaf = int(pending.leaf)
             f = int(pending.feature)
-            tbin = int(pending.threshold_bin)
-            mapper = self.dataset.bin_mappers[f]
-            common = dict(
-                leaf=leaf, feature=self.dataset.real_feature_index(f),
-                feature_inner=f,
-                left_value=float(pending.left_output),
-                right_value=float(pending.right_output),
-                left_count=int(round(float(pending.left_count))),
-                right_count=int(round(float(pending.right_count))),
-                left_weight=float(pending.left_sum_hess),
-                right_weight=float(pending.right_sum_hess),
-                gain=float(pending.gain))
-            if bool(pending.is_categorical):
-                bin_mask = np.asarray(pending.cat_mask)
-                cats = [mapper.bin_2_categorical[b]
-                        for b in np.nonzero(bin_mask)[0]
-                        if b < len(mapper.bin_2_categorical)]
-                tree.split_categorical(
-                    cat_values=cats, bin_mask=bin_mask, **common)
-            else:
-                tree.split(
-                    threshold_bin=tbin,
-                    threshold_real=self.dataset.real_threshold(f, tbin),
-                    missing_type=mapper.missing_type,
-                    default_left=bool(pending.default_left), **common)
+            apply_split_record(tree, self.dataset, pending)
             children_allowed = self._splittable(int(tree.leaf_depth[leaf]))
             smaller = min(float(pending.left_total_count),
                           float(pending.right_total_count))
             S = self._bucket(smaller)
             paths[leaf] = paths[k] = paths.get(leaf, frozenset()) | {f}
-            if per_node:
-                mask_left = self._node_mask(feature_mask, paths[leaf])
-                mask_right = self._node_mask(feature_mask, paths[k])
-            else:
-                mask_left = mask_right = feature_mask
+            mask_left = self._node_mask(feature_mask, paths[leaf])
+            mask_right = self._node_mask(feature_mask, paths[k])
             state, rec = self._step_fn(S)(
-                state, jnp.int32(leaf), jnp.int32(k),
-                jnp.asarray(children_allowed), mask_left, mask_right)
+                self.bins, state, jnp.int32(leaf), jnp.int32(k),
+                jnp.asarray(children_allowed), mask_left, mask_right,
+                rand_seed, self.meta, self.params, self._btab)
             pending = jax.device_get(rec)
-        return tree, state.leaf_of_row[:self.N]
+        return state

@@ -1,0 +1,8 @@
+"""Shared small utilities (reference analogue: include/LightGBM/utils/
+common.h helpers; most of that header is subsumed by numpy/XLA)."""
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    n = max(int(n), 1)
+    return 1 << (n - 1).bit_length()

@@ -4,15 +4,45 @@ Mirrors the reference's test strategy of faking a cluster on one host
 (reference: tests/distributed/_test_distributed.py spawns N localhost
 processes); here N virtual XLA host devices stand in for N TPU chips.
 Must run before jax initializes.
+
+When the TPU-tunnel plugin env (PALLAS_AXON_POOL_IPS) is present, merely
+setting JAX_PLATFORMS=cpu is NOT enough: the plugin registered at
+interpreter start can wedge any jax backend init in this process. The
+pytest process re-execs itself once with the plugin env scrubbed (same
+trick as __graft_entry__.scrubbed_cpu_env). The exec happens in
+pytest_configure — after stopping pytest's fd-level capture (so the new
+process writes to the real stdout) and before collection imports any
+test module (so jax is not yet initialized).
 """
 import os
+import sys
 
-# force-set: the environment may pre-set JAX_PLATFORMS=axon (the TPU
-# tunnel); tests must run on the virtual CPU mesh regardless
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["JAX_PLATFORM_NAME"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+_NEEDS_SCRUB = bool(os.environ.get("PALLAS_AXON_POOL_IPS")
+                    and not os.environ.get("LGBM_TPU_TESTS_SCRUBBED"))
+
+if not _NEEDS_SCRUB:
+    # force-set: the environment may pre-set JAX_PLATFORMS=axon (the TPU
+    # tunnel); tests must run on the virtual CPU mesh regardless
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["JAX_PLATFORM_NAME"] = "cpu"
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            xla_flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+
+def pytest_configure(config):
+    if not _NEEDS_SCRUB:
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        try:
+            capman.stop_global_capturing()
+        except Exception:
+            pass
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["LGBM_TPU_TESTS_SCRUBBED"] = "1"
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest"] + sys.argv[1:], env)

@@ -147,3 +147,98 @@ def test_rollback_restores_scores():
     bst.rollback_one_iter()
     np.testing.assert_allclose(np.asarray(bst.inner.train_score), score5,
                                atol=1e-5)
+
+
+class TestForcedSplits:
+    """forcedsplits_filename (reference: SerialTreeLearner::ForceSplits,
+    serial_tree_learner.cpp:451)."""
+
+    def test_forced_root_split_is_used(self, tmp_path):
+        import json
+        import lightgbm_tpu as lgb
+        rng = np.random.RandomState(0)
+        X = rng.randn(800, 5)
+        y = (X[:, 0] + 0.3 * rng.randn(800) > 0).astype(np.float64)
+        fs = tmp_path / "forced.json"
+        # force the root onto feature 3 (NOT the naturally best feature 0)
+        fs.write_text(json.dumps({"feature": 3, "threshold": 0.0}))
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbose": -1,
+                         "forcedsplits_filename": str(fs)},
+                        lgb.Dataset(X, label=y), num_boost_round=3)
+        for tree in bst.inner.models:
+            if tree.num_internal > 0:
+                assert tree.split_feature[0] == 3
+
+    def test_forced_chain(self, tmp_path):
+        import json
+        import lightgbm_tpu as lgb
+        rng = np.random.RandomState(1)
+        X = rng.randn(800, 5)
+        y = (X[:, 0] > 0).astype(np.float64)
+        fs = tmp_path / "forced.json"
+        fs.write_text(json.dumps(
+            {"feature": 2, "threshold": 0.0,
+             "left": {"feature": 4, "threshold": 0.5}}))
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbose": -1,
+                         "forcedsplits_filename": str(fs)},
+                        lgb.Dataset(X, label=y), num_boost_round=2)
+        t = bst.inner.models[0]
+        assert t.split_feature[0] == 2
+        assert t.split_feature[1] == 4
+        # prediction still self-consistent
+        p = bst.predict(X)
+        assert p.shape == (800,)
+
+
+class TestPathSmooth:
+    def test_path_smooth_shrinks_toward_parent(self):
+        import lightgbm_tpu as lgb
+        rng = np.random.RandomState(2)
+        X = rng.randn(600, 4)
+        y = X[:, 0] * 2 + 0.1 * rng.randn(600)
+        p_plain = lgb.train({"objective": "regression", "num_leaves": 15,
+                             "verbose": -1},
+                            lgb.Dataset(X, label=y),
+                            num_boost_round=5).predict(X)
+        p_smooth = lgb.train({"objective": "regression", "num_leaves": 15,
+                              "verbose": -1, "path_smooth": 100.0},
+                             lgb.Dataset(X, label=y),
+                             num_boost_round=5).predict(X)
+        # heavy smoothing must change (dampen) predictions
+        assert not np.allclose(p_plain, p_smooth)
+        assert np.var(p_smooth) < np.var(p_plain)
+
+
+class TestExtraTrees:
+    def test_extra_trees_differs_and_learns(self):
+        import lightgbm_tpu as lgb
+        rng = np.random.RandomState(3)
+        X = rng.randn(800, 6)
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+        p0 = lgb.train({"objective": "binary", "num_leaves": 15,
+                        "verbose": -1},
+                       lgb.Dataset(X, label=y),
+                       num_boost_round=10).predict(X)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbose": -1, "extra_trees": True},
+                        lgb.Dataset(X, label=y), num_boost_round=10)
+        p1 = bst.predict(X)
+        assert not np.allclose(p0, p1)  # random thresholds differ
+        sep = p1[y == 1].mean() - p1[y == 0].mean()
+        assert sep > 0.2  # still learns
+
+
+class TestParamWarnings:
+    def test_cegb_warns(self, capsys):
+        from lightgbm_tpu.config import Config
+        Config.from_params({"cegb_tradeoff": 2.0, "verbosity": 1})
+        assert "CEGB" in capsys.readouterr().err
+
+    def test_monotone_method_falls_back(self, capsys):
+        from lightgbm_tpu.config import Config
+        cfg = Config.from_params({"monotone_constraints_method": "advanced",
+                                  "verbosity": 1})
+        assert cfg.monotone_constraints_method == "basic"
+        assert "monotone_constraints_method" in capsys.readouterr().err
