@@ -62,6 +62,25 @@ class DataParallelTreeLearner:
 
     def __init__(self, config, dataset: BinnedDataset, mesh: Mesh,
                  axis: str = "data"):
+        bins_host_full = self._init_mesh_common(config, dataset, mesh,
+                                                axis)
+        N, F = bins_host_full.shape
+        if F == 0:
+            log.fatal("Cannot train without features")
+        self.N, self.F = N, F
+        n_dev = mesh.devices.size
+        # pad rows to a devices multiple; pad rows carry leaf -1 / gh 0
+        self.R = -(-N // n_dev) * n_dev
+        pad = np.zeros((self.R - N, F), dtype=bins_host_full.dtype)
+        bins_host = np.concatenate([bins_host_full, pad], axis=0)
+        self.bins = jax.device_put(
+            bins_host, NamedSharding(mesh, P(self.axis, None)))
+
+    def _init_mesh_common(self, config, dataset: BinnedDataset,
+                          mesh: Mesh, axis: str):
+        """Shared mesh-learner setup (also used by the multi-process
+        DistributedDataParallelLearner); returns the per-feature host bin
+        matrix (unbundled if the dataset carries EFB bundles)."""
         self.config = config
         self.dataset = dataset
         self.mesh = mesh
@@ -75,33 +94,23 @@ class DataParallelTreeLearner:
             bins_host_full = dataset.feature_bins()
         else:
             bins_host_full = dataset.bins
-        N, F = bins_host_full.shape
-        if F == 0:
-            log.fatal("Cannot train without features")
-        self.N, self.F = N, F
         # power-of-two histogram width (see SerialTreeLearner: canonical
         # shapes share compiled variants across datasets)
         from ..utils import next_pow2
         self.B = next_pow2(max(int(dataset.max_num_bin), 2))
         self.L = int(config.num_leaves)
         self.max_depth = int(config.max_depth)
-        n_dev = mesh.devices.size
-        # pad rows to a devices multiple; pad rows carry leaf -1 / gh 0
-        self.R = -(-N // n_dev) * n_dev
-        pad = np.zeros((self.R - N, F), dtype=bins_host_full.dtype)
-        bins_host = np.concatenate([bins_host_full, pad], axis=0)
-        self.row_sharding = NamedSharding(mesh, P(self.axis))
+        self._hist_slots = self.L
+        self.row_sharding = NamedSharding(mesh, P(axis))
         self.rep_sharding = NamedSharding(mesh, P())
         # histograms: replicated after the cross-row psum (the
         # feature-parallel subclass keeps them feature-sharded instead)
         self.hist_sharding = self.rep_sharding
-        self.gh_sharding = NamedSharding(mesh, P(self.axis, None))
-        self.bins = jax.device_put(
-            bins_host, NamedSharding(mesh, P(self.axis, None)))
+        self.gh_sharding = NamedSharding(mesh, P(axis, None))
         self.meta = jax.device_put(
             FeatureMeta.from_dataset(dataset,
                                      int(config.max_cat_to_onehot)),
-                                   self.rep_sharding)
+            self.rep_sharding)
         self.params = jax.device_put(SplitParams.from_config(config),
                                      self.rep_sharding)
         self._ff_rng = np.random.RandomState(config.feature_fraction_seed)
@@ -111,6 +120,7 @@ class DataParallelTreeLearner:
             log.warning("extra_trees is only implemented in the serial "
                         "(single-chip) learner; the mesh-parallel learners "
                         "run full greedy threshold scans")
+        return bins_host_full
 
     # ------------------------------------------------------------------
     def _sample_features(self) -> jnp.ndarray:
@@ -138,7 +148,8 @@ class DataParallelTreeLearner:
         leaf_of_row = jax.lax.with_sharding_constraint(
             leaf_of_row, self.row_sharding)
         state = make_root_state(gh, hist, leaf_of_row, info, self.L,
-                                self.F, self.B, children_allowed)
+                                self.F, self.B, children_allowed,
+                                hist_slots=self._hist_slots)
         return state, _record_at(state, 0)
 
     def _step_impl(self, bins, state: GrowState, leaf, new_leaf,
@@ -160,29 +171,22 @@ class DataParallelTreeLearner:
         ltc, rtc = (state.left_total_count[leaf],
                     state.right_total_count[leaf])
         smaller_is_left = ltc <= rtc
-        small_id = jnp.where(smaller_is_left, leaf, new_leaf)
-        # masked histogram over the full sharded row space: the TPU
-        # analogue of the reference ranks histogramming only their local
-        # rows of the leaf, then ReduceScatter-summing
-        small_mask = (leaf_of_row == small_id).astype(jnp.float32)
-        hist_small = build_histogram(bins, state.gh * small_mask[:, None], B)
-        hist_small = jax.lax.with_sharding_constraint(
-            hist_small, self.hist_sharding)
-        hist_large = subtract_histogram(state.hists[leaf], hist_small)
-        hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
-        hist_right = jnp.where(smaller_is_left, hist_large, hist_small)
-        hists = state.hists.at[leaf].set(hist_left) \
-                           .at[new_leaf].set(hist_right)
+        (hist_left, hist_right, mask_left,
+         mask_right) = self._children_histograms(
+            bins, state, leaf, new_leaf, leaf_of_row, smaller_is_left,
+            feature_mask)
+        hists = self._update_hist_store(state, leaf, new_leaf, hist_left,
+                                        hist_right)
 
         lc, rc = state.left_count[leaf], state.right_count[leaf]
         left_info = find_best_split(
             hist_left, state.left_sum_grad[leaf],
-            state.left_sum_hess[leaf], lc, ltc, meta, params, feature_mask,
+            state.left_sum_hess[leaf], lc, ltc, meta, params, mask_left,
             state.cand_left_min[leaf], state.cand_left_max[leaf],
             parent_output=state.left_output[leaf])
         right_info = find_best_split(
             hist_right, state.right_sum_grad[leaf],
-            state.right_sum_hess[leaf], rc, rtc, meta, params, feature_mask,
+            state.right_sum_hess[leaf], rc, rtc, meta, params, mask_right,
             state.cand_right_min[leaf], state.cand_right_max[leaf],
             parent_output=state.right_output[leaf])
 
@@ -191,6 +195,32 @@ class DataParallelTreeLearner:
         state = _store_info(state, new_leaf, right_info, children_allowed)
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best)
+
+    def _children_histograms(self, bins, state, leaf, new_leaf,
+                             leaf_of_row, smaller_is_left, feature_mask):
+        """Cross-device-summed child histograms + the per-child scan
+        masks. Base learner: masked histogram of the smaller child over
+        the full sharded row space (the analogue of the reference ranks
+        histogramming their local leaf rows then ReduceScatter-summing,
+        data_parallel_tree_learner.cpp:185), sibling by subtraction.
+        Voting-parallel overrides this with the reduced-comm vote."""
+        small_id = jnp.where(smaller_is_left, leaf, new_leaf)
+        small_mask = (leaf_of_row == small_id).astype(jnp.float32)
+        hist_small = build_histogram(bins, state.gh * small_mask[:, None],
+                                     self.B)
+        hist_small = jax.lax.with_sharding_constraint(
+            hist_small, self.hist_sharding)
+        hist_large = subtract_histogram(state.hists[leaf], hist_small)
+        hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
+        hist_right = jnp.where(smaller_is_left, hist_large, hist_small)
+        return hist_left, hist_right, feature_mask, feature_mask
+
+    def _update_hist_store(self, state, leaf, new_leaf, hist_left,
+                           hist_right):
+        """Per-leaf histogram pool update (the subtraction trick reads
+        these; the voting learner overrides this to skip the store)."""
+        return state.hists.at[leaf].set(hist_left) \
+                          .at[new_leaf].set(hist_right)
 
     # ------------------------------------------------------------------
     def _ensure_compiled(self):

@@ -2,35 +2,36 @@
 
 TPU-native equivalent of the reference's ``VotingParallelTreeLearner``
 (reference: src/treelearner/voting_parallel_tree_learner.cpp — PV-tree:
-each rank proposes its local top-k features (:243-394), an Allgather of
-``LightSplitInfo`` lets every rank compute the global vote (GlobalVoting,
-:151), and only the ~2k voted features' histograms are summed across ranks
-(CopyLocalHistogram, :184), cutting comm volume from O(F*B) to O(2k*B).
+each rank proposes its local top-k features (:243-394), a vote over the
+gathered proposals picks ~2k global features (GlobalVoting, :151), and
+only the voted features' histograms cross the network
+(CopyLocalHistogram, :184), cutting comm volume from O(F·B) to O(2k·B).
 
-Here the same three phases run under ``shard_map`` over the data axis:
-local histogram → local per-feature best gains → ``all_gather`` of local
-top-k feature ids (the vote) → ``psum`` restricted to the voted feature
-block → replicated scan over that block. On TPU this matters when the
-mesh spans hosts (DCN-bound); within one ICI domain the plain
-data-parallel full-histogram psum is usually faster.
+Here the whole vote runs inside the jitted split step under ``shard_map``
+over the data axis, per child leaf (the reference also revotes per leaf):
+local shard histogram → local per-feature best gains → local top-k →
+``psum`` of vote counts (an [F] i32 vector) → global top-2k ids →
+slice the [V, B, 4] voted block → ``psum`` it → scatter back to a full
+[F, B, 4] buffer for the replicated scan, with the scan masked to the
+voted set. Cross-device bytes per child: F·4 + V·B·16 instead of
+F·B·16. The histogram-subtraction trick is NOT used here — different
+leaves vote different features, so both children are histogrammed
+locally (a masked full-shard pass each, same local cost) and reduced on
+their own voted sets, mirroring the reference's smaller/larger buffers.
 """
 from __future__ import annotations
 
-from functools import partial
-from typing import Optional, Tuple
-
 import jax
 import jax.numpy as jnp
-import numpy as np
-from jax.experimental.shard_map import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..io.dataset import BinnedDataset
-from ..ops.histogram import build_histogram, subtract_histogram
-from ..ops.split import (FeatureMeta, SplitParams, find_best_split,
-                         leaf_gain, calculate_leaf_output,
-                         leaf_gain_given_output)
-from ..treelearner.serial import _go_left_by_bin, _record_at, _store_info
+from ..ops.histogram import build_histogram
+from ..ops.split import leaf_gain
 from .data_parallel import DataParallelTreeLearner
 
 
@@ -58,72 +59,63 @@ def _per_feature_best_gain(hist, sum_grad, sum_hess, sum_count, meta,
 
 
 class VotingParallelTreeLearner(DataParallelTreeLearner):
-    """Data-parallel learner whose cross-device histogram reduction is
-    restricted to globally voted features."""
+    """Data-parallel learner whose cross-device histogram traffic is
+    restricted to per-leaf globally voted features."""
 
     def __init__(self, config, dataset: BinnedDataset, mesh: Mesh,
                  axis: str = "data"):
         super().__init__(config, dataset, mesh, axis)
-        self.top_k = min(int(config.top_k), self.F)
+        self.top_k = max(1, min(int(config.top_k), self.F))
+        self.n_voted = min(2 * self.top_k, self.F)
+        # no subtraction trick here → per-leaf histograms are never read
+        # back; keep a single hist slot instead of [L, F, B, 4]
+        self._hist_slots = 1
 
-    def _voted_feature_mask(self, gh, leaf_mask, feature_mask):
-        """Phase 1+2: local histograms → local top-k → global vote
-        (reference: GlobalVoting, voting_parallel_tree_learner.cpp:151).
-        Returns a replicated bool[F] mask of ~2k voted features."""
+    def _voted_reduced_histogram(self, bins, gh_masked, feature_mask):
+        """One child's globally-summed histogram, reduced only on voted
+        features; returns ([F, B, 4] hist with unvoted rows zero,
+        bool[F] voted mask)."""
         mesh, axis = self.mesh, self.axis
-        meta, params, B, k = self.meta, self.params, self.B, self.top_k
+        meta, params, B, F = self.meta, self.params, self.B, self.F
+        k, V = self.top_k, self.n_voted
 
-        def local_vote(bins_shard, gh_shard):
-            hist = build_histogram(bins_shard, gh_shard, B)
-            sums = jnp.sum(gh_shard, axis=0)
-            gains = _per_feature_best_gain(
-                hist, sums[0], sums[1], sums[2], meta, params,
-                feature_mask)
+        def local(bins_shard, gh_shard, fmask):
+            h = build_histogram(bins_shard, gh_shard, B)    # local partial
+            s = jnp.sum(gh_shard, axis=0)                   # local sums
+            gains = _per_feature_best_gain(h, s[0], s[1], s[2], meta,
+                                           params, fmask)
             _, top_ids = jax.lax.top_k(gains, k)
-            votes = jnp.zeros(self.F, dtype=jnp.int32).at[top_ids].add(1)
-            votes = jax.lax.psum(votes, axis)          # the Allgather+count
-            return votes
+            # a shard with no valid local split must not vote at all
+            # (top_k on all--inf gains returns arbitrary low indices)
+            has_split = jnp.isfinite(gains[top_ids]).astype(jnp.int32)
+            votes = jnp.zeros(F, dtype=jnp.int32) \
+                .at[top_ids].add(has_split)
+            votes = jax.lax.psum(votes, axis)               # [F] i32 — tiny
+            _, voted = jax.lax.top_k(votes, V)              # replicated ids
+            hv = jax.lax.psum(h[voted], axis)               # [V, B, 4] — the
+            #                                    reduced histogram traffic
+            full = jnp.zeros((F, B, 4), jnp.float32).at[voted].set(hv)
+            vmask = jnp.zeros(F, dtype=bool).at[voted].set(True)
+            return full, vmask
 
-        votes = shard_map(
-            local_vote, mesh=mesh,
-            in_specs=(P(axis, None), P(axis, None)),
-            out_specs=P())(self.bins,
-                           gh * leaf_mask[:, None])
-        _, voted = jax.lax.top_k(votes, min(2 * k, self.F))
-        mask = jnp.zeros(self.F, dtype=bool).at[voted].set(True)
-        return mask & feature_mask
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(axis, None), P(axis, None), P()),
+            out_specs=(P(), P()))(bins, gh_masked, feature_mask)
 
-    def _step_impl(self, bins, state, leaf, new_leaf, children_allowed,
-                   feature_mask):
-        """Same dataflow as the data-parallel step, with the best-split
-        scan restricted to voted features. The full-histogram psum is
-        avoided for unvoted features by zero-masking before the
-        cross-device reduction (XLA still reduces the buffer, but the
-        voted mask keeps the scan semantics of the reference; a DCN
-        deployment would slice the buffer instead)."""
-        return super()._step_impl(bins, state, leaf, new_leaf,
-                                  children_allowed, feature_mask)
+    def _children_histograms(self, bins, state, leaf, new_leaf,
+                             leaf_of_row, smaller_is_left, feature_mask):
+        left_id = leaf  # left child keeps the split leaf's id
+        mask_l = (leaf_of_row == left_id).astype(jnp.float32)
+        mask_r = (leaf_of_row == new_leaf).astype(jnp.float32)
+        hist_left, voted_l = self._voted_reduced_histogram(
+            bins, state.gh * mask_l[:, None], feature_mask)
+        hist_right, voted_r = self._voted_reduced_histogram(
+            bins, state.gh * mask_r[:, None], feature_mask)
+        return (hist_left, hist_right, feature_mask & voted_l,
+                feature_mask & voted_r)
 
-    def train(self, grad, hess, bag=None):
-        # vote once per tree on the root distribution (the reference
-        # revotes per leaf; per-tree voting keeps one compiled step and
-        # is the same comm bound)
-        pad_n = self.R - self.N
-        ind = jnp.ones(self.N, dtype=jnp.float32) if bag is None else bag
-        gh = jnp.stack([grad * ind, hess * ind, ind,
-                        jnp.ones(self.N, dtype=jnp.float32)], axis=1)
-        if pad_n:
-            gh = jnp.concatenate(
-                [gh, jnp.zeros((pad_n, 4), dtype=jnp.float32)], axis=0)
-        gh = jax.device_put(gh, self.gh_sharding)
-        base_mask = self._sample_features()
-        voted = self._voted_feature_mask(
-            gh, jnp.ones(self.R, dtype=jnp.float32), base_mask)
-        self._voted_mask = voted
-        # delegate to the data-parallel loop with the voted mask
-        old_sample = self._sample_features
-        try:
-            self._sample_features = lambda: voted
-            return super().train(grad, hess, bag)
-        finally:
-            self._sample_features = old_sample
+    def _update_hist_store(self, state, leaf, new_leaf, hist_left,
+                           hist_right):
+        # histograms are re-voted fresh per leaf; nothing reads the store
+        return state.hists

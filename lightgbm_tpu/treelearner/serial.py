@@ -183,13 +183,17 @@ def _store_info(state: GrowState, leaf, info: SplitInfo, allowed,
 
 
 def make_root_state(gh, hist, leaf_of_row, info, L: int, F: int, B: int,
-                    children_allowed) -> GrowState:
+                    children_allowed, hist_slots: int = 0) -> GrowState:
     """Initial GrowState after the root histogram+scan (shared by the
-    serial and mesh-parallel learners)."""
+    serial and mesh-parallel learners). ``hist_slots`` shrinks the
+    per-leaf histogram store for learners that never re-read it (the
+    voting learner re-votes per leaf instead of subtracting)."""
+    hist_slots = hist_slots or L
     zf = lambda: jnp.zeros(L, dtype=jnp.float32)
     state = GrowState(
         leaf_of_row=leaf_of_row, gh=gh,
-        hists=jnp.zeros((L, F, B, 4), dtype=jnp.float32).at[0].set(hist),
+        hists=jnp.zeros((hist_slots, F, B, 4),
+                        dtype=jnp.float32).at[0].set(hist),
         leaf_depth=jnp.zeros(L, dtype=jnp.int32),
         gain=jnp.full(L, _NEG_INF, dtype=jnp.float32),
         feature=jnp.full(L, -1, dtype=jnp.int32),

@@ -1,0 +1,50 @@
+"""One fake-cluster worker process (reference:
+tests/distributed/_test_distributed.py DistributedMockup — N copies of
+the binary on localhost). Spawned by tests/test_distributed_multiproc.py
+with a scrubbed CPU env; each worker holds a row shard, joins the gRPC
+coordinator, trains one distributed tree, and dumps its results."""
+import sys
+
+import numpy as np
+
+
+def main() -> None:
+    rank = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    out = sys.argv[4]
+
+    import jax
+    jax.distributed.initialize("127.0.0.1:%s" % port, nproc, rank)
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.parallel.distributed import (
+        DistributedDataParallelLearner, distributed_binned_dataset,
+        global_mesh)
+
+    rng = np.random.RandomState(0)
+    n, f = 800, 6
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 > 0.3)
+    lo, hi = rank * (n // nproc), (rank + 1) * (n // nproc)
+    cfg = Config.from_params({"num_leaves": 15, "min_data_in_leaf": 5,
+                              "bin_construct_sample_cnt": n,
+                              "verbosity": -1})
+    ds = distributed_binned_dataset(X[lo:hi], cfg)
+    mesh = global_mesh()
+    lrn = DistributedDataParallelLearner(cfg, ds, mesh)
+    grad = np.where(y[lo:hi], -0.5, 0.5).astype(np.float32)
+    hess = np.full(hi - lo, 0.25, dtype=np.float32)
+    tree, part = lrn.train(grad, hess)
+    local_leaf = lrn.local_leaf_assignment(part)
+    np.savez(out,
+             split_feature=tree.split_feature[:tree.num_internal],
+             threshold_in_bin=tree.threshold_in_bin[:tree.num_internal],
+             leaf_value=tree.leaf_value[:tree.num_leaves],
+             local_leaf=local_leaf,
+             num_leaves=np.asarray([tree.num_leaves]))
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Two-process fake cluster on localhost (reference:
+tests/distributed/_test_distributed.py:53 DistributedMockup): spawn two
+worker processes that bootstrap ``jax.distributed`` over a loopback gRPC
+coordinator, each holding half the rows, and assert the distributed tree
+equals the single-process one."""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "distributed",
+                       "_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env(n_devices: int) -> dict:
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=%d" % n_devices)
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+@pytest.mark.slow
+def test_two_process_tree_matches_single_process(tmp_path):
+    nproc = 2
+    port = _free_port()
+    outs = [str(tmp_path / ("w%d.npz" % r)) for r in range(nproc)]
+    procs = [subprocess.Popen(
+        [sys.executable, _WORKER, str(r), str(nproc), str(port), outs[r]],
+        env=_worker_env(2), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+        for r in range(nproc)]
+    logs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        logs.append(out)
+    for r, p in enumerate(procs):
+        assert p.returncode == 0, "worker %d failed:\n%s" % (r, logs[r])
+
+    w = [np.load(o) for o in outs]
+    # both processes must have built the identical tree
+    np.testing.assert_array_equal(w[0]["split_feature"],
+                                  w[1]["split_feature"])
+    np.testing.assert_array_equal(w[0]["threshold_in_bin"],
+                                  w[1]["threshold_in_bin"])
+    np.testing.assert_allclose(w[0]["leaf_value"], w[1]["leaf_value"],
+                               rtol=1e-6)
+
+    # ... and it must equal the single-process tree on the full data
+    import jax.numpy as jnp
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.treelearner.serial import SerialTreeLearner
+    rng = np.random.RandomState(0)
+    n, f = 800, 6
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 > 0.3)
+    cfg = Config.from_params({"num_leaves": 15, "min_data_in_leaf": 5,
+                              "bin_construct_sample_cnt": n,
+                              "verbosity": -1})
+    ds = BinnedDataset.from_matrix(X, cfg)
+    serial = SerialTreeLearner(cfg, ds)
+    grad = jnp.asarray(np.where(y, -0.5, 0.5).astype(np.float32))
+    hess = jnp.full(n, 0.25, dtype=jnp.float32)
+    tree, part = serial.train(grad, hess)
+    assert int(w[0]["num_leaves"][0]) == tree.num_leaves
+    np.testing.assert_array_equal(w[0]["split_feature"],
+                                  tree.split_feature[:tree.num_internal])
+    np.testing.assert_array_equal(
+        w[0]["threshold_in_bin"],
+        tree.threshold_in_bin[:tree.num_internal])
+    np.testing.assert_allclose(w[0]["leaf_value"],
+                               tree.leaf_value[:tree.num_leaves],
+                               rtol=2e-3, atol=1e-5)
+    # per-row leaf assignment: distributed shards == single-process rows
+    full_leaf = np.asarray(part)
+    np.testing.assert_array_equal(w[0]["local_leaf"], full_leaf[:400])
+    np.testing.assert_array_equal(w[1]["local_leaf"], full_leaf[400:])
