@@ -1,0 +1,91 @@
+"""Generate the small synthetic datasets committed under examples/.
+
+The reference ships real sample data (examples/binary_classification/
+binary.train etc.); with zero egress here, deterministic synthetic
+equivalents are generated instead. Run from the repo root:
+
+    python examples/generate_data.py
+
+Formats follow the reference conventions: TSV, label in column 0, no
+header; lambdarank additionally writes ``<file>.query`` with rows per
+query (reference: docs on query data / Metadata::SetQuery).
+"""
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _write(path, y, X, extra_cols=()):
+    arr = np.column_stack([y] + [c for c in extra_cols] + [X])
+    np.savetxt(path, arr, delimiter="\t", fmt="%.6g")
+
+
+def binary(n_train=1000, n_test=300, seed=11):
+    rng = np.random.RandomState(seed)
+    d = os.path.join(HERE, "binary_classification")
+    os.makedirs(d, exist_ok=True)
+    for name, n in (("binary.train", n_train), ("binary.test", n_test)):
+        X = rng.randn(n, 10)
+        X[:, 3] = np.round(np.abs(X[:, 3]) * 2)  # low-cardinality column
+        logit = X[:, 0] + 0.8 * X[:, 1] * X[:, 2] - 0.5 * X[:, 3]
+        y = (logit + 0.3 * rng.randn(n) > 0).astype(float)
+        _write(os.path.join(d, name), y, X)
+
+
+def regression(n_train=800, n_test=200, seed=12):
+    rng = np.random.RandomState(seed)
+    d = os.path.join(HERE, "regression")
+    os.makedirs(d, exist_ok=True)
+    for name, n in (("regression.train", n_train),
+                    ("regression.test", n_test)):
+        X = rng.randn(n, 8)
+        y = (2.0 * X[:, 0] + np.sin(X[:, 1]) + 0.5 * X[:, 2] ** 2
+             + 0.1 * rng.randn(n))
+        _write(os.path.join(d, name), y, X)
+
+
+def multiclass(n_train=900, n_test=240, seed=13):
+    rng = np.random.RandomState(seed)
+    d = os.path.join(HERE, "multiclass_classification")
+    os.makedirs(d, exist_ok=True)
+    for name, n in (("multiclass.train", n_train),
+                    ("multiclass.test", n_test)):
+        X = rng.randn(n, 6)
+        score = np.stack([X[:, 0] + X[:, 1], X[:, 2] - X[:, 1],
+                          0.5 * X[:, 3] + 0.2 * rng.randn(n)], axis=1)
+        y = np.argmax(score, axis=1).astype(float)
+        _write(os.path.join(d, name), y, X)
+
+
+def lambdarank(n_queries_train=40, n_queries_test=12, seed=14):
+    rng = np.random.RandomState(seed)
+    d = os.path.join(HERE, "lambdarank")
+    os.makedirs(d, exist_ok=True)
+    for name, nq in (("rank.train", n_queries_train),
+                     ("rank.test", n_queries_test)):
+        rows, labels, qsizes = [], [], []
+        for _ in range(nq):
+            sz = rng.randint(8, 25)
+            qsizes.append(sz)
+            X = rng.randn(sz, 7)
+            rel = X[:, 0] + 0.5 * X[:, 1] + 0.3 * rng.randn(sz)
+            # graded relevance 0-4 by within-query rank
+            order = np.argsort(np.argsort(-rel))
+            lab = np.clip(4 - order // max(sz // 5, 1), 0, 4)
+            rows.append(X)
+            labels.append(lab.astype(float))
+        X = np.vstack(rows)
+        y = np.concatenate(labels)
+        _write(os.path.join(d, name), y, X)
+        np.savetxt(os.path.join(d, name + ".query"), np.array(qsizes),
+                   fmt="%d")
+
+
+if __name__ == "__main__":
+    binary()
+    regression()
+    multiclass()
+    lambdarank()
+    print("examples data written under", HERE)

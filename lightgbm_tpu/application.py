@@ -101,6 +101,19 @@ def _load_tabular(path: str, config: Config):
     return X, y, weights
 
 
+def _sidecar(data_path: str, kind: str):
+    """Auto-load ``<data>.query`` / ``<data>.weight`` sidecar files
+    (reference: Metadata::Init reads query/weight files next to the data
+    file, src/io/metadata.cpp — LoadQueryBoundaries/LoadWeights)."""
+    import os
+    path = data_path + "." + kind
+    if not os.path.exists(path):
+        return None
+    vals = np.loadtxt(path)
+    vals = np.atleast_1d(vals)
+    return vals.astype(np.int32) if kind == "query" else vals
+
+
 def run(argv: Optional[List[str]] = None) -> int:
     """reference: Application::Run (include/LightGBM/application.h:79)."""
     argv = sys.argv[1:] if argv is None else argv
@@ -110,13 +123,18 @@ def run(argv: Optional[List[str]] = None) -> int:
 
     if task == "train":
         X, y, w = _load_tabular(config.data, config)
-        ds = Dataset(X, label=y, weight=w, params=params)
+        g = _sidecar(config.data, "query")
+        w = w if w is not None else _sidecar(config.data, "weight")
+        ds = Dataset(X, label=y, weight=w, group=g, params=params)
         valid_sets = []
         valid_names = []
-        for i, vpath in enumerate(
-                v for v in str(config.valid).split(",") if v):
+        valid_paths = (config.valid if isinstance(config.valid, list)
+                       else [v for v in str(config.valid).split(",") if v])
+        for i, vpath in enumerate(valid_paths):
             Xv, yv, wv = _load_tabular(vpath, config)
-            valid_sets.append(Dataset(Xv, label=yv, weight=wv,
+            gv = _sidecar(vpath, "query")
+            wv = wv if wv is not None else _sidecar(vpath, "weight")
+            valid_sets.append(Dataset(Xv, label=yv, weight=wv, group=gv,
                                       reference=ds, params=params))
             valid_names.append("valid_%d" % i)
         from .engine import train as train_fn
@@ -153,6 +171,19 @@ def run(argv: Optional[List[str]] = None) -> int:
                     decay_rate=config.refit_decay_rate)
         out = config.output_model or "LightGBM_model.txt"
         new_booster.save_model(out)
+        return 0
+
+    if task == "convert_model":
+        # reference: Application::ConvertModel (application.cpp) with
+        # convert_model_language=cpp → GBDT::SaveModelToIfElse
+        booster = Booster(params=params, model_file=config.input_model)
+        lang = (config.convert_model_language or "cpp").lower()
+        if lang not in ("cpp", "c++"):
+            log.fatal("convert_model_language=%s is not supported "
+                      "(only cpp)" % lang)
+        out = config.convert_model or "gradient_boosting_model.cpp"
+        booster.inner.save_model_to_cpp(out)
+        log.info("Converted model saved to %s" % out)
         return 0
 
     if task == "save_binary":

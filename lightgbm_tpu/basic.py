@@ -378,6 +378,14 @@ class Booster:
         ni = self._resolve_num_iteration(num_iteration)
         return self.inner.save_model_to_string(start_iteration, ni)
 
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> dict:
+        """Model as a JSON-serializable dict (reference:
+        Booster.dump_model → LGBM_BoosterDumpModel → GBDT::DumpModel)."""
+        ni = self._resolve_num_iteration(num_iteration)
+        return self.inner.dump_model(start_iteration, ni, importance_type)
+
     def _resolve_num_iteration(self, num_iteration) -> int:
         if num_iteration is None:
             return self.best_iteration if self.best_iteration > 0 else -1

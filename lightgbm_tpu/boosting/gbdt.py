@@ -709,6 +709,61 @@ class GBDT:
             f.write(self.save_model_to_string(start_iteration,
                                               num_iteration))
 
+    def dump_model(self, start_iteration: int = 0,
+                   num_iteration: int = -1,
+                   importance_type: str = "split") -> Dict:
+        """JSON-dump structure (reference: GBDT::DumpModel,
+        gbdt_model_text.cpp:21-170)."""
+        d: Dict = {
+            "name": self.submodel_name,
+            "version": "v3",
+            "num_class": self.num_class,
+            "num_tree_per_iteration": self.num_tree_per_iteration,
+            "label_index": self.label_idx,
+            "max_feature_idx": self.max_feature_idx,
+        }
+        if self.objective is not None:
+            d["objective"] = self.objective.to_string()
+        d["average_output"] = bool(self.average_output)
+        d["feature_names"] = list(self.feature_names)
+        d["monotone_constraints"] = list(self.monotone_constraints or [])
+        infos: Dict = {}
+        for i, info in enumerate(self.feature_infos):
+            if i >= len(self.feature_names):
+                break
+            if info.startswith("["):
+                lo, hi = info[1:-1].split(":")
+                infos[self.feature_names[i]] = {
+                    "min_value": float(lo), "max_value": float(hi),
+                    "values": []}
+            elif info != "none":
+                vals = [int(v) for v in info.split(":")]
+                infos[self.feature_names[i]] = {
+                    "min_value": min(vals), "max_value": max(vals),
+                    "values": vals}
+        d["feature_infos"] = infos
+        models = self._used_models(start_iteration, num_iteration)
+        tree_info = []
+        for i, tree in enumerate(models):
+            tj = tree.to_json()
+            tj["tree_index"] = i
+            tree_info.append(tj)
+        d["tree_info"] = tree_info
+        imp = self.feature_importance(importance_type, num_iteration)
+        d["feature_importances"] = {
+            self.feature_names[i]: (int(imp[i]) if
+                                    importance_type == "split"
+                                    else float(imp[i]))
+            for i in range(len(imp)) if imp[i] > 0}
+        return d
+
+    def save_model_to_cpp(self, filename: str) -> None:
+        """``convert_model`` task output (reference:
+        GBDT::SaveModelToIfElse, gbdt_model_text.cpp:286)."""
+        from ..models.codegen import model_to_cpp
+        with open(filename, "w") as f:
+            f.write(model_to_cpp(self))
+
     def load_model_from_string(self, s: str) -> None:
         """reference: GBDT::LoadModelFromString
         (gbdt_model_text.cpp:421)."""

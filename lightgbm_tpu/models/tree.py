@@ -437,6 +437,96 @@ class Tree:
         return t
 
     # ------------------------------------------------------------------
+    def _cats_of(self, cat_idx: int) -> List[int]:
+        """Expand a stored bitset back to category values (reference:
+        Tree::NodeToJSON's FindInBitset loop, src/io/tree.cpp:466-477)."""
+        lo = self.cat_boundaries[cat_idx]
+        hi = self.cat_boundaries[cat_idx + 1]
+        out = []
+        for w in range(hi - lo):
+            word = int(self.cat_threshold[lo + w])
+            for j in range(32):
+                if (word >> j) & 1:
+                    out.append(w * 32 + j)
+        return out
+
+    def _linear_json(self, leaf: int) -> dict:
+        return {
+            "leaf_const": float(self.leaf_const[leaf]),
+            "leaf_features": list(self.leaf_features[leaf]),
+            "leaf_coeff": [float(c) for c in self.leaf_coeff[leaf]],
+        }
+
+    def _node_to_json(self, index: int) -> dict:
+        """reference: Tree::NodeToJSON (src/io/tree.cpp:455-520).
+        Iterative (explicit post-order) — chain-shaped trees can be
+        num_leaves-1 deep, past Python's recursion limit."""
+        order: List[int] = []
+        stack = [index]
+        while stack:
+            idx = stack.pop()
+            order.append(idx)
+            if idx >= 0:
+                stack.append(int(self.left_child[idx]))
+                stack.append(int(self.right_child[idx]))
+        memo: dict = {}
+        for idx in reversed(order):
+            if idx < 0:
+                leaf = ~idx
+                d = {
+                    "leaf_index": int(leaf),
+                    "leaf_value": float(self.leaf_value[leaf]),
+                    "leaf_weight": float(self.leaf_weight[leaf]),
+                    "leaf_count": int(self.leaf_count[leaf]),
+                }
+                if self.is_linear:
+                    d.update(self._linear_json(leaf))
+                memo[idx] = d
+                continue
+            dt = int(self.decision_type[idx])
+            if dt & kCategoricalMask:
+                cat_idx = int(self.threshold_in_bin[idx])
+                threshold = "||".join(str(c) for c in self._cats_of(cat_idx))
+                decision = "=="
+            else:
+                threshold = float(self.threshold[idx])
+                decision = "<="
+            missing = (dt >> 2) & 3
+            missing_name = ("None", "Zero", "NaN", "NaN")[missing]
+            memo[idx] = {
+                "split_index": int(idx),
+                "split_feature": int(self.split_feature[idx]),
+                "split_gain": float(self.split_gain[idx]),
+                "threshold": threshold,
+                "decision_type": decision,
+                "default_left": bool(dt & kDefaultLeftMask),
+                "missing_type": missing_name,
+                "internal_value": float(self.internal_value[idx]),
+                "internal_weight": float(self.internal_weight[idx]),
+                "internal_count": int(self.internal_count[idx]),
+                "left_child": memo[int(self.left_child[idx])],
+                "right_child": memo[int(self.right_child[idx])],
+            }
+        return memo[index]
+
+    def to_json(self) -> dict:
+        """JSON-dump structure (reference: Tree::ToJSON,
+        src/io/tree.cpp:411-429)."""
+        d = {
+            "num_leaves": int(self.num_leaves),
+            "num_cat": int(self.num_cat),
+            "shrinkage": float(self.shrinkage),
+        }
+        if self.num_leaves == 1:
+            root = {"leaf_value": float(self.leaf_value[0])}
+            if self.is_linear:
+                root.update(self._linear_json(0))
+            d["tree_structure"] = root
+        else:
+            d["tree_structure"] = self._node_to_json(0)
+        return d
+
+    # ------------------------------------------------------------------
     @property
     def num_internal(self) -> int:
         return max(self.num_leaves - 1, 0)

@@ -31,7 +31,6 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
-from ..io.binning import BinMapper
 from ..io.dataset import BinnedDataset
 from ..models.tree import Tree
 from ..utils import log
@@ -84,7 +83,13 @@ def distributed_binned_dataset(local_X: np.ndarray, config: Config,
             (1, local_X.shape[1]), dtype=local_X.dtype)
         pad = np.repeat(pad_row, max_take - take, axis=0)
         sample = np.concatenate([sample, pad], axis=0)
-    gathered = np.asarray(multihost_utils.process_allgather(sample))
+    # allgather as int32 bit patterns: process_allgather canonicalizes
+    # float64 -> float32 (and int64 -> int32) when x64 is off, which
+    # would round the bin boundaries; two int32 words per double
+    # round-trip exactly
+    bits = np.ascontiguousarray(sample).view(np.int32)
+    gathered_bits = np.asarray(multihost_utils.process_allgather(bits))
+    gathered = np.ascontiguousarray(gathered_bits).view(np.float64)
     parts = [gathered[p][:int(np.asarray(counts)[p, 0])]
              for p in range(n_proc)]
     full_sample = np.concatenate(parts, axis=0)

@@ -1,0 +1,93 @@
+"""CLI-vs-Python-API consistency over the committed examples/ configs —
+the analogue of the reference's
+tests/python_package_test/test_consistency.py:12-39 (``FileLoader`` reads
+examples/*/train.conf, trains both ways, compares)."""
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.application import parse_args, run, _load_tabular, _sidecar
+from lightgbm_tpu.config import Config
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+class FileLoader:
+    """reference: test_consistency.py FileLoader."""
+
+    def __init__(self, directory, prefix, tmp_path):
+        self.directory = os.path.join(EXAMPLES, directory)
+        self.prefix = prefix
+        self.tmp = str(tmp_path)
+        self.params = parse_args(
+            ["config=" + os.path.join(self.directory, "train.conf")])
+        # paths in conf are relative to the example dir
+        for key in ("data", "valid", "valid_data"):
+            if key in self.params:
+                self.params[key] = os.path.join(self.directory,
+                                                self.params[key])
+        self.params["output_model"] = os.path.join(self.tmp, "model.txt")
+        self.params["verbosity"] = "-1"
+
+    def train_cli(self):
+        rc = run(["%s=%s" % (k, v) for k, v in self.params.items()])
+        assert rc == 0
+        return self.params["output_model"]
+
+    def load(self, name):
+        cfg = Config.from_params({k: v for k, v in self.params.items()
+                                  if k not in ("config",)})
+        path = os.path.join(self.directory, self.prefix + name)
+        X, y, w = _load_tabular(path, cfg)
+        g = _sidecar(path, "query")
+        return X, y, w, g
+
+
+CASES = [
+    ("binary_classification", "binary.", "binary"),
+    ("regression", "regression.", "regression"),
+    ("multiclass_classification", "multiclass.", "multiclass"),
+    ("lambdarank", "rank.", "lambdarank"),
+]
+
+
+@pytest.mark.parametrize("directory,prefix,objective", CASES)
+def test_cli_matches_python(directory, prefix, objective, tmp_path):
+    fl = FileLoader(directory, prefix, tmp_path)
+    model_path = fl.train_cli()
+    assert os.path.exists(model_path)
+    cli_bst = lgb.Booster(model_file=model_path)
+
+    # train the same config through the Python API
+    X, y, w, g = fl.load("train")
+    params = {k: v for k, v in fl.params.items()
+              if k not in ("config", "task", "data", "valid", "valid_data",
+                           "output_model", "num_trees", "num_iterations")}
+    n_rounds = int(fl.params.get("num_trees",
+                                 fl.params.get("num_iterations", 10)))
+    ds = lgb.Dataset(X, label=y, weight=w, group=g, params=params)
+    api_bst = lgb.train(params, ds, num_boost_round=n_rounds)
+
+    Xt, _, _, _ = fl.load("test")
+    np.testing.assert_allclose(cli_bst.predict(Xt), api_bst.predict(Xt),
+                               rtol=1e-9, atol=1e-12)
+
+
+def test_cli_predict_task(tmp_path):
+    fl = FileLoader("binary_classification", "binary.", tmp_path)
+    model_path = fl.train_cli()
+    out = os.path.join(str(tmp_path), "preds.txt")
+    rc = run(["task=predict",
+              "data=" + os.path.join(fl.directory, "binary.test"),
+              "input_model=" + model_path,
+              "output_result=" + out])
+    assert rc == 0
+    preds = np.loadtxt(out)
+    bst = lgb.Booster(model_file=model_path)
+    Xt, _, _, _ = fl.load("test")
+    np.testing.assert_allclose(preds, bst.predict(Xt), rtol=1e-9)
+    assert np.all((preds >= 0) & (preds <= 1))
