@@ -58,6 +58,10 @@ def _load_tabular(path: str, config: Config):
     is_libsvm = all(":" in t for t in tokens[1:2]) and ":" in first
     has_header = bool(config.header)
     if is_libsvm:
+        from .native import parse_libsvm
+        parsed = parse_libsvm(path)
+        if parsed is not None:
+            return parsed[0], parsed[1], None
         rows, labels = [], []
         max_idx = -1
         for line in open(path):
@@ -76,8 +80,11 @@ def _load_tabular(path: str, config: Config):
             for i, v in feats.items():
                 X[r, i] = v
         return X, np.asarray(labels), None
-    data = np.genfromtxt(path, delimiter=delim,
-                         skip_header=1 if has_header else 0)
+    from .native import parse_dense
+    data = parse_dense(path, delim, 1 if has_header else 0)
+    if data is None:
+        data = np.genfromtxt(path, delimiter=delim,
+                             skip_header=1 if has_header else 0)
     if data.ndim == 1:
         data = data.reshape(1, -1)
     label_col = 0

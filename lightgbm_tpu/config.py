@@ -425,17 +425,10 @@ class Config:
         """Accepted-but-not-yet-implemented knobs warn LOUDLY instead of
         silently corrupting experiments (round-2 review, Weak #5).
         Pure CPU-layout hints are no-ops by design on the TPU build."""
-        if (self.cegb_tradeoff != 1.0 or self.cegb_penalty_split != 0.0
-                or self.cegb_penalty_feature_lazy
-                or self.cegb_penalty_feature_coupled):
-            log.warning("CEGB (cegb_*) is not implemented yet; the "
-                        "penalties are IGNORED")
-        if self.monotone_penalty != 0.0:
-            log.warning("monotone_penalty is not implemented yet and is "
-                        "IGNORED")
-        if self.monotone_constraints_method not in ("basic",):
-            log.warning("monotone_constraints_method=%s is not implemented;"
-                        " falling back to 'basic'"
+        if self.monotone_constraints_method not in (
+                "basic", "intermediate", "advanced"):
+            log.warning("unknown monotone_constraints_method=%s; "
+                        "falling back to 'basic'"
                         % self.monotone_constraints_method)
             self.monotone_constraints_method = "basic"
         if self.two_round:

@@ -1,0 +1,117 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference keeps its data-loading runtime in C++ (src/io/parser.cpp,
+src/io/dataset_loader.cpp); this package holds the TPU build's native
+equivalents. Sources compile on first use with the system g++ into a
+cached shared object next to the source (no pybind11 dependency — plain
+C ABI + ctypes), and every entry point has a NumPy fallback so a missing
+toolchain degrades gracefully.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_FAILED = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    """Compile parser.cpp into _parser.so (once; cached on disk) and
+    load it. Returns None when no working toolchain is available."""
+    global _LIB, _LIB_FAILED
+    with _LOCK:
+        if _LIB is not None or _LIB_FAILED:
+            return _LIB
+        src = os.path.join(_HERE, "parser.cpp")
+        so = os.path.join(_HERE, "_parser.so")
+        try:
+            if (not os.path.exists(so)
+                    or os.path.getmtime(so) < os.path.getmtime(src)):
+                subprocess.check_call(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     "-o", so + ".tmp", src],
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+                os.replace(so + ".tmp", so)
+            lib = ctypes.CDLL(so)
+            lib.ParseDense.restype = ctypes.c_int
+            lib.ParseDense.argtypes = [
+                ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_long)]
+            lib.ParseLibSVM.restype = ctypes.c_int
+            lib.ParseLibSVM.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_long)]
+            lib.FreeBuffer.restype = None
+            lib.FreeBuffer.argtypes = [ctypes.c_void_p]
+            _LIB = lib
+        except Exception:
+            _LIB_FAILED = True
+            from ..utils import log
+            log.warning("native parser unavailable (g++ build failed); "
+                        "falling back to numpy text parsing")
+        return _LIB
+
+
+def parse_dense(path: str, delim: str, skip_rows: int
+                ) -> Optional[np.ndarray]:
+    """Parse a CSV/TSV file into a row-major float64 array, or None if
+    the native library is unavailable (caller falls back to numpy)."""
+    lib = _build_and_load()
+    if lib is None:
+        return None
+    out = ctypes.POINTER(ctypes.c_double)()
+    rows = ctypes.c_long()
+    cols = ctypes.c_long()
+    rc = lib.ParseDense(path.encode(), delim.encode(), skip_rows,
+                        ctypes.byref(out), ctypes.byref(rows),
+                        ctypes.byref(cols))
+    if rc != 0:
+        if rc == 1:
+            raise OSError("cannot read %s" % path)
+        return None
+    try:
+        n = rows.value * cols.value
+        arr = np.ctypeslib.as_array(out, shape=(n,)).copy()
+        return arr.reshape(rows.value, cols.value)
+    finally:
+        lib.FreeBuffer(out)
+
+
+def parse_libsvm(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Parse a LibSVM file → (dense X, labels), or None if unavailable."""
+    lib = _build_and_load()
+    if lib is None:
+        return None
+    out = ctypes.POINTER(ctypes.c_double)()
+    labels = ctypes.POINTER(ctypes.c_double)()
+    rows = ctypes.c_long()
+    cols = ctypes.c_long()
+    rc = lib.ParseLibSVM(path.encode(), ctypes.byref(out),
+                         ctypes.byref(labels), ctypes.byref(rows),
+                         ctypes.byref(cols))
+    if rc != 0:
+        if rc == 1:
+            raise OSError("cannot read %s" % path)
+        return None
+    try:
+        n = rows.value * cols.value
+        X = np.ctypeslib.as_array(out, shape=(n,)).copy() \
+            .reshape(rows.value, cols.value)
+        y = np.ctypeslib.as_array(labels, shape=(rows.value,)).copy()
+        return X, y
+    finally:
+        lib.FreeBuffer(out)
+        lib.FreeBuffer(labels)

@@ -22,6 +22,7 @@ docs/GPU-Performance.rst precedent).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +31,38 @@ import jax.numpy as jnp
 # ROW_TILE * F * B * 4 bytes per scan step; XLA additionally tiles the
 # contraction, so this just bounds the scan carry granularity.
 DEFAULT_ROW_TILE = 512
+
+# Rows per Pallas grid step (the kernel's VMEM working set scales with
+# this; 2048 rows × 28 features ≈ 1.2 MB of transients).
+PALLAS_ROW_TILE = 2048
+
+
+@functools.lru_cache(maxsize=1)
+def _use_pallas() -> bool:
+    """Pallas path only on real TPU backends; the einsum-scan fallback
+    serves CPU tests and interpret-mode debugging. A tiny probe kernel
+    runs once per process so a Mosaic compile/runtime failure degrades
+    to the fallback instead of killing training."""
+    if os.environ.get("LGBM_TPU_NO_PALLAS"):
+        return False
+    try:
+        if jax.default_backend() != "tpu" or _pl is None:
+            return False
+        probe = _pallas_histogram(
+            jnp.zeros((PALLAS_ROW_TILE, 2), dtype=jnp.uint8),
+            jnp.ones((PALLAS_ROW_TILE, 4), dtype=jnp.float32),
+            16, PALLAS_ROW_TILE)
+        ok = float(probe[0, 0, 3]) == float(PALLAS_ROW_TILE)
+        if not ok:
+            from ..utils import log
+            log.warning("Pallas histogram probe produced wrong sums; "
+                        "using the einsum fallback")
+        return ok
+    except Exception as e:  # pragma: no cover - depends on runtime
+        from ..utils import log
+        log.warning("Pallas histogram unavailable (%s); using the "
+                    "einsum fallback" % type(e).__name__)
+        return False
 
 
 def _tile_histogram(bins_tile: jnp.ndarray, gh_tile: jnp.ndarray,
@@ -43,8 +76,86 @@ def _tile_histogram(bins_tile: jnp.ndarray, gh_tile: jnp.ndarray,
         preferred_element_type=jnp.float32)
 
 
+def _hist_kernel_body(T: int, F: int, H: int, C: int, bins_ref, gh_ref,
+                      out_ref):
+    """Pallas TPU kernel: one grid step accumulates a [T, F] row tile
+    into the [F*H, 16*C] VMEM-resident histogram accumulator.
+
+    The bin index factorizes as ``bin = hi*16 + lo``; per feature the
+    contribution is ``A_f^T @ W_f`` where ``A_f[t, hi]`` is the hi-nibble
+    one-hot and ``W_f[t, lo*C+c] = (lo_f[t] == lo) * gh[t, c]``. This
+    shapes the MXU matmul as [H, T] x [T, 16*C] — N = 16*C lanes instead
+    of the naive one-hot's N = C, and the one-hot factors never leave
+    VMEM (the einsum fallback materializes S*F*B floats through HBM).
+    Equivalent of the reference's shared-memory histogram kernels
+    (cuda_histogram_constructor.cu:18, ocl/histogram256.cl)."""
+    @_pl.when(_pl.program_id(0) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    b = bins_ref[...].astype(jnp.int32)          # [T, F]
+    g = gh_ref[...]                              # [T, C]
+    hi = b >> 4
+    lo = b & 15
+    g_rep = jnp.tile(g, (1, 16))                 # [T, 16*C]
+    lane_lo = (jax.lax.broadcasted_iota(jnp.int32, (1, 16 * C), 1)
+               // C)                             # [1, 16*C]
+    iota_h = jax.lax.broadcasted_iota(jnp.int32, (1, H), 1)
+
+    def body(f, carry):
+        hi_f = jax.lax.dynamic_slice(hi, (0, f), (T, 1))     # [T, 1]
+        lo_f = jax.lax.dynamic_slice(lo, (0, f), (T, 1))
+        A = (hi_f == iota_h).astype(jnp.float32)             # [T, H]
+        W = jnp.where(lo_f == lane_lo, g_rep, 0.0)           # [T, 16C]
+        acc = jax.lax.dot_general(
+            A, W, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [H, 16C]
+        out_ref[_pl.ds(f * H, H), :] += acc
+        return carry
+
+    jax.lax.fori_loop(0, F, body, 0)
+
+
+try:  # Pallas is TPU-only machinery; import lazily-tolerantly
+    from jax.experimental import pallas as _pl
+    from jax.experimental.pallas import tpu as _pltpu
+except Exception:  # pragma: no cover
+    _pl = None
+    _pltpu = None
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _pallas_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
+                      row_tile: int) -> jnp.ndarray:
+    S, F = bins.shape
+    C = gh.shape[1]
+    H = -(-num_bins // 16)                       # hi-nibble width
+    T = row_tile
+    pad = (-S) % T
+    if pad:
+        bins = jnp.concatenate(
+            [bins, jnp.zeros((pad, F), dtype=bins.dtype)])
+        gh = jnp.concatenate([gh, jnp.zeros((pad, C), dtype=gh.dtype)])
+    n_tiles = bins.shape[0] // T
+    kernel = functools.partial(_hist_kernel_body, T, F, H, C)
+    out = _pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            _pl.BlockSpec((T, F), lambda i: (i, 0)),
+            _pl.BlockSpec((T, C), lambda i: (i, 0)),
+        ],
+        out_specs=_pl.BlockSpec((F * H, 16 * C), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F * H, 16 * C), jnp.float32),
+    )(bins, gh)
+    # [F*H, 16*C] -> [F, H*16, C] -> [F, B, C]
+    hist = out.reshape(F, H, 16, C).reshape(F, H * 16, C)
+    return hist[:, :num_bins, :]
+
+
 def build_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
-                    row_tile: int = DEFAULT_ROW_TILE) -> jnp.ndarray:
+                    row_tile: int = DEFAULT_ROW_TILE,
+                    pallas_ok: bool = True) -> jnp.ndarray:
     """Accumulate (grad, hess, count) per (feature, bin).
 
     Parameters
@@ -53,11 +164,17 @@ def build_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
         carry gh == 0; their bin values are irrelevant)
     gh : f32 [S, C] — per-row stats; C is typically 3 = (grad, hess, in-bag)
     num_bins : static histogram width B
+    pallas_ok : callers whose rows are SHARDED across a device mesh must
+        pass False — pallas_call has no SPMD partitioning rule, so GSPMD
+        would all-gather the full bins array per device; the einsum path
+        partitions cleanly and lets XLA insert the psum.
 
     Returns f32 [F, B, C].
     """
     S, F = bins.shape
     C = gh.shape[1]
+    if pallas_ok and _use_pallas() and S >= PALLAS_ROW_TILE and C <= 8:
+        return _pallas_histogram(bins, gh, num_bins, PALLAS_ROW_TILE)
     if S <= row_tile:
         return _tile_histogram(bins, gh, num_bins)
     # Pad S to a tile multiple; padded rows use gh = 0 so they vanish.

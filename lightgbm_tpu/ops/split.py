@@ -52,6 +52,11 @@ class SplitParams(NamedTuple):
     min_data_per_group: jnp.ndarray
     max_cat_threshold: jnp.ndarray
     path_smooth: jnp.ndarray = 0.0
+    # CEGB scalars (cost_effective_gradient_boosting.hpp:80-87)
+    cegb_tradeoff: jnp.ndarray = 1.0
+    cegb_penalty_split: jnp.ndarray = 0.0
+    # monotone split gain penalty (config.h:503)
+    monotone_penalty: jnp.ndarray = 0.0
 
     @classmethod
     def from_config(cls, config) -> "SplitParams":
@@ -67,6 +72,9 @@ class SplitParams(NamedTuple):
             min_data_per_group=jnp.float32(config.min_data_per_group),
             max_cat_threshold=jnp.int32(config.max_cat_threshold),
             path_smooth=jnp.float32(config.path_smooth),
+            cegb_tradeoff=jnp.float32(config.cegb_tradeoff),
+            cegb_penalty_split=jnp.float32(config.cegb_penalty_split),
+            monotone_penalty=jnp.float32(config.monotone_penalty),
         )
 
 
@@ -243,7 +251,9 @@ def find_best_split(hist: jnp.ndarray,
                     min_output=None,
                     max_output=None,
                     parent_output=None,
-                    rand_bins=None) -> SplitInfo:
+                    rand_bins=None,
+                    gain_penalty=None,
+                    leaf_depth=None) -> SplitInfo:
     """Scan a leaf histogram for the best (feature, threshold) pair.
 
     Parameters
@@ -457,6 +467,27 @@ def find_best_split(hist: jnp.ndarray,
     gains = jnp.stack([gain_r - shift_num, gain_l - shift_num,
                        gain_oh - shift_cat, gain_cs_f - shift_cat,
                        gain_cs_r - shift_cat])
+    if gain_penalty is not None:
+        # CEGB per-feature gain penalty (reference:
+        # CostEfficientGradientBoosting::DeltaGain,
+        # cost_effective_gradient_boosting.hpp:80 — threshold-independent,
+        # so it reorders features without changing per-feature thresholds)
+        gains = gains - gain_penalty[None, :, None]
+    if leaf_depth is not None:
+        # monotone split gain penalty (reference:
+        # ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:355):
+        # gains of splits on monotone features shrink with depth
+        kMPEps = 1e-10
+        p = params.monotone_penalty
+        d = leaf_depth.astype(jnp.float32)
+        factor = jnp.where(
+            p >= d + 1.0, kMPEps,
+            jnp.where(p <= 1.0,
+                      1.0 - p / jnp.exp2(d) + kMPEps,
+                      1.0 - jnp.exp2(p - 1.0 - d) + kMPEps))
+        is_mono = (meta.monotone != 0) & (p > 0.0)
+        mult = jnp.where(is_mono, factor, 1.0)[None, :, None]
+        gains = jnp.where(jnp.isfinite(gains), gains * mult, gains)
 
     flat = gains.reshape(-1)
     best = jnp.argmax(flat)

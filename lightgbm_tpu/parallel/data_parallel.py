@@ -120,6 +120,21 @@ class DataParallelTreeLearner:
             log.warning("extra_trees is only implemented in the serial "
                         "(single-chip) learner; the mesh-parallel learners "
                         "run full greedy threshold scans")
+        # serial-learner-only features: warn LOUDLY instead of silently
+        # ignoring (these knobs would otherwise corrupt experiments)
+        if (config.cegb_tradeoff < 1.0 or config.cegb_penalty_split > 0.0
+                or config.cegb_penalty_feature_coupled
+                or config.cegb_penalty_feature_lazy):
+            log.warning("CEGB (cegb_*) is only implemented in the serial "
+                        "learner; IGNORED by mesh-parallel learners")
+        if config.monotone_penalty != 0.0:
+            log.warning("monotone_penalty is only implemented in the "
+                        "serial learner; IGNORED here")
+        if (config.monotone_constraints_method != "basic"
+                and dataset.monotone_constraints is not None):
+            log.warning("monotone_constraints_method=%s degrades to "
+                        "'basic' in mesh-parallel learners"
+                        % config.monotone_constraints_method)
         return bins_host_full
 
     # ------------------------------------------------------------------
@@ -133,8 +148,18 @@ class DataParallelTreeLearner:
         return jax.device_put(jnp.asarray(mask), self.rep_sharding)
 
     # ------------------------------------------------------------------
+    def _initial_partition(self, gh):
+        """Root row→leaf vector: rows 0, pad rows -1. Subclasses with a
+        different pad layout (per-process interleaved pads in the
+        multi-process learner) override this."""
+        leaf_of_row = jnp.concatenate([
+            jnp.zeros(self.N, dtype=jnp.int32),
+            jnp.full((self.R - self.N,), -1, dtype=jnp.int32)])
+        return jax.lax.with_sharding_constraint(leaf_of_row,
+                                                self.row_sharding)
+
     def _root_impl(self, bins, gh, feature_mask, children_allowed):
-        hist = build_histogram(bins, gh, self.B)
+        hist = build_histogram(bins, gh, self.B, pallas_ok=False)
         hist = jax.lax.with_sharding_constraint(hist, self.hist_sharding)
         sums = jnp.sum(gh, axis=0)
         from ..ops.split import calculate_leaf_output
@@ -142,11 +167,7 @@ class DataParallelTreeLearner:
         info = find_best_split(hist, sums[0], sums[1], sums[2], sums[3],
                                self.meta, self.params, feature_mask,
                                parent_output=parent_out)
-        leaf_of_row = jnp.concatenate([
-            jnp.zeros(self.N, dtype=jnp.int32),
-            jnp.full((self.R - self.N,), -1, dtype=jnp.int32)])
-        leaf_of_row = jax.lax.with_sharding_constraint(
-            leaf_of_row, self.row_sharding)
+        leaf_of_row = self._initial_partition(gh)
         state = make_root_state(gh, hist, leaf_of_row, info, self.L,
                                 self.F, self.B, children_allowed,
                                 hist_slots=self._hist_slots)
@@ -207,7 +228,7 @@ class DataParallelTreeLearner:
         small_id = jnp.where(smaller_is_left, leaf, new_leaf)
         small_mask = (leaf_of_row == small_id).astype(jnp.float32)
         hist_small = build_histogram(bins, state.gh * small_mask[:, None],
-                                     self.B)
+                                     self.B, pallas_ok=False)
         hist_small = jax.lax.with_sharding_constraint(
             hist_small, self.hist_sharding)
         hist_large = subtract_histogram(state.hists[leaf], hist_small)
@@ -231,11 +252,8 @@ class DataParallelTreeLearner:
     def _splittable(self, depth: int) -> bool:
         return self.max_depth <= 0 or depth < self.max_depth
 
-    def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
-              bag: Optional[jnp.ndarray] = None) -> Tuple[Tree, jnp.ndarray]:
-        """Grow one tree over the sharded dataset. Same contract as
-        SerialTreeLearner.train (treelearner/serial.py)."""
-        self._ensure_compiled()
+    def _make_gh(self, grad, hess, bag) -> jnp.ndarray:
+        """[N] grad/hess (+bag) → padded sharded [R, 4] gh matrix."""
         pad_n = self.R - self.N
         ind = jnp.ones(self.N, dtype=jnp.float32) if bag is None else bag
         gh = jnp.stack([grad * ind, hess * ind, ind,
@@ -243,7 +261,17 @@ class DataParallelTreeLearner:
         if pad_n:
             gh = jnp.concatenate(
                 [gh, jnp.zeros((pad_n, 4), dtype=jnp.float32)], axis=0)
-        gh = jax.device_put(gh, self.gh_sharding)
+        return jax.device_put(gh, self.gh_sharding)
+
+    def _finalize_partition(self, leaf_of_row):
+        return leaf_of_row[:self.N]
+
+    def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
+              bag: Optional[jnp.ndarray] = None) -> Tuple[Tree, jnp.ndarray]:
+        """Grow one tree over the sharded dataset. Same contract as
+        SerialTreeLearner.train (treelearner/serial.py)."""
+        self._ensure_compiled()
+        gh = self._make_gh(grad, hess, bag)
         feature_mask = self._sample_features()
 
         tree = Tree(self.L)
@@ -260,4 +288,4 @@ class DataParallelTreeLearner:
                 self.bins, state, jnp.int32(leaf), jnp.int32(k),
                 jnp.asarray(children_allowed), feature_mask)
             pending = jax.device_get(rec)
-        return tree, state.leaf_of_row[:self.N]
+        return tree, self._finalize_partition(state.leaf_of_row)

@@ -22,7 +22,7 @@ pre-partitioned loading, dataset_loader.cpp:203-260):
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -32,7 +32,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
 from ..io.dataset import BinnedDataset
-from ..models.tree import Tree
 from ..utils import log
 from .data_parallel import DataParallelTreeLearner
 
@@ -140,9 +139,9 @@ class DistributedDataParallelLearner(DataParallelTreeLearner):
         self.bins = jax.make_array_from_process_local_data(
             NamedSharding(mesh, P(self.axis, None)), local_bins)
 
-    def make_global_gh(self, grad: np.ndarray, hess: np.ndarray,
-                       bag: Optional[np.ndarray] = None) -> jnp.ndarray:
-        """Local [n_local] grad/hess → global padded [R, 4] sharded gh."""
+    def _make_gh(self, grad, hess, bag) -> jnp.ndarray:
+        """Local [n_local] numpy grad/hess shard → global padded sharded
+        [R, 4] gh matrix (overrides the single-process device path)."""
         n = self._n_local
         ind = np.ones(n, dtype=np.float32) if bag is None \
             else np.asarray(bag, dtype=np.float32)
@@ -154,51 +153,19 @@ class DistributedDataParallelLearner(DataParallelTreeLearner):
         return jax.make_array_from_process_local_data(
             self.gh_sharding, gh_local)
 
-    def _root_impl(self, bins, gh, feature_mask, children_allowed):
-        # identical to the parent, except the initial partition marks
-        # each process's local pad rows -1 (they are interleaved
-        # per-process, not a single tail)
-        from ..ops.histogram import build_histogram
-        from ..ops.split import calculate_leaf_output, find_best_split
-        from ..treelearner.serial import (_record_at, make_root_state)
-        hist = build_histogram(bins, gh, self.B)
-        hist = jax.lax.with_sharding_constraint(hist, self.hist_sharding)
-        sums = jnp.sum(gh, axis=0)
-        parent_out = calculate_leaf_output(sums[0], sums[1], self.params)
-        info = find_best_split(hist, sums[0], sums[1], sums[2], sums[3],
-                               self.meta, self.params, feature_mask,
-                               parent_output=parent_out)
-        # rows with total-count channel 0 are padding
-        leaf_of_row = jnp.where(gh[:, 3] > 0.0, 0, -1).astype(jnp.int32)
-        leaf_of_row = jax.lax.with_sharding_constraint(
-            leaf_of_row, self.row_sharding)
-        state = make_root_state(gh, hist, leaf_of_row, info, self.L,
-                                self.F, self.B, children_allowed,
-                                hist_slots=self._hist_slots)
-        return state, _record_at(state, 0)
+    # kept as the public name used by callers/tests
+    make_global_gh = _make_gh
 
-    def train(self, grad, hess, bag=None) -> Tuple[Tree, jnp.ndarray]:
-        """grad/hess are LOCAL numpy shards here."""
-        self._ensure_compiled()
-        gh = self.make_global_gh(grad, hess, bag)
-        feature_mask = self._sample_features()
-        tree = Tree(self.L)
-        from ..treelearner.serial import (apply_split_record,
-                                          record_is_valid)
-        state, rec = self._root_fn(self.bins, gh, feature_mask,
-                                   self._splittable(0))
-        pending = jax.device_get(rec)
-        for k in range(1, self.L):
-            if not record_is_valid(pending):
-                break
-            leaf = int(pending.leaf)
-            apply_split_record(tree, self.dataset, pending)
-            children_allowed = self._splittable(int(tree.leaf_depth[leaf]))
-            state, rec = self._step_fn(
-                self.bins, state, jnp.int32(leaf), jnp.int32(k),
-                jnp.asarray(children_allowed), feature_mask)
-            pending = jax.device_get(rec)
-        return tree, state.leaf_of_row
+    def _initial_partition(self, gh):
+        # each process's local pad rows are interleaved per-process, not
+        # a single tail: rows with total-count channel 0 are padding
+        leaf_of_row = jnp.where(gh[:, 3] > 0.0, 0, -1).astype(jnp.int32)
+        return jax.lax.with_sharding_constraint(
+            leaf_of_row, self.row_sharding)
+
+    def _finalize_partition(self, leaf_of_row):
+        # keep the global sharded vector; local_leaf_assignment slices it
+        return leaf_of_row
 
     def local_leaf_assignment(self, leaf_of_row) -> np.ndarray:
         """This process's [n_local] slice of the global partition."""

@@ -1,0 +1,194 @@
+"""Intermediate monotone-constraint tracking (host side).
+
+Equivalent of the reference's ``IntermediateLeafConstraints``
+(src/treelearner/monotone_constraints.hpp:508-855): per-leaf (min, max)
+output bounds that, unlike ``basic`` mode, are tightened with the actual
+sibling outputs instead of the mid-point, and are *propagated* to every
+other leaf that is value-contiguous with the new children (found by
+walking up from the split node and down the opposite branches). Each
+touched leaf's best-split candidate is then recomputed — on the device,
+from its stored histogram (reference:
+SerialTreeLearner::RecomputeBestSplitForLeaf,
+serial_tree_learner.cpp:800).
+
+The tree-walk itself is pure O(num_leaves) pointer chasing over the host
+``Tree``, so it stays in Python; only the rescans run on device.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models.tree import Tree, kCategoricalMask
+
+_INF = float("inf")
+
+
+class IntermediateMonotoneTracker:
+    """Host mirror of per-leaf output bounds + the contiguity walk."""
+
+    def __init__(self, num_leaves: int, monotone_inner: np.ndarray):
+        self.L = num_leaves
+        self.mono = np.asarray(monotone_inner, dtype=np.int8)
+        self.reset()
+
+    def reset(self) -> None:
+        self.entries: List[Tuple[float, float]] = \
+            [(-_INF, _INF) for _ in range(self.L)]
+        self.in_mono_subtree = [False] * self.L
+        # node_parent_[node] — parent internal node of each internal node
+        self.node_parent = [-1] * max(self.L - 1, 1)
+
+    # ------------------------------------------------------------------
+    def before_split(self, tree: Tree, leaf: int, mono_type: int) -> None:
+        """reference: IntermediateLeafConstraints::BeforeSplit
+        (monotone_constraints.hpp:530) — must run BEFORE the split is
+        applied to the host tree (it records the pre-split leaf parent
+        as the new node's parent)."""
+        new_leaf = tree.num_leaves
+        new_node = tree.num_leaves - 1
+        if mono_type != 0 or self.in_mono_subtree[leaf]:
+            self.in_mono_subtree[leaf] = True
+            self.in_mono_subtree[new_leaf] = True
+        self.node_parent[new_node] = int(tree.leaf_parent[leaf])
+
+    def child_bounds(self, leaf: int, mono_type: int,
+                     left_output: float, right_output: float
+                     ) -> Tuple[float, float, float, float]:
+        """Bounds the two children inherit + the entry updates
+        (reference: UpdateConstraintsWithOutputs,
+        monotone_constraints.hpp:543 — sibling outputs, not mid-points).
+        Returns (lmin, lmax, rmin, rmax)."""
+        pmin, pmax = self.entries[leaf]
+        lmin, lmax = pmin, pmax
+        rmin, rmax = pmin, pmax
+        if mono_type < 0:
+            lmin = max(lmin, right_output)   # left ≥ right for decreasing
+            rmax = min(rmax, left_output)
+        elif mono_type > 0:
+            lmax = min(lmax, right_output)
+            rmin = max(rmin, left_output)
+        return lmin, lmax, rmin, rmax
+
+    def apply_split(self, tree: Tree, leaf: int, new_leaf: int,
+                    bounds: Tuple[float, float, float, float]) -> None:
+        self.entries[leaf] = (bounds[0], bounds[1])
+        self.entries[new_leaf] = (bounds[2], bounds[3])
+
+    # ------------------------------------------------------------------
+    def leaves_to_update(self, tree: Tree, new_leaf: int,
+                         split_feature_inner: int, split_threshold: int,
+                         left_output: float, right_output: float,
+                         is_numerical: bool,
+                         leaf_has_candidate) -> List[int]:
+        """The GoUp/GoDown walk (reference: GoUpToFindLeavesToUpdate /
+        GoDownToFindLeavesToUpdate, monotone_constraints.hpp:620-805).
+        ``leaf_has_candidate(leaf) -> bool`` mirrors the reference's
+        ``best_split_per_leaf[leaf].gain == kMinScore`` skip. Updates
+        ``self.entries`` in place; returns the leaves needing a device
+        rescan."""
+        out: List[int] = []
+        if not self.in_mono_subtree[new_leaf]:
+            return out
+        feats_up: List[int] = []
+        thr_up: List[int] = []
+        was_right: List[bool] = []
+
+        node = int(tree.leaf_parent[new_leaf])
+        child_code = node  # start: the new split node (walk begins above)
+        parent = self.node_parent[node] if node >= 0 else -1
+        while parent != -1:
+            inner = int(tree.split_feature_inner[parent])
+            mono_type = int(self.mono[inner]) \
+                if inner < len(self.mono) else 0
+            is_right = int(tree.right_child[parent]) == child_code
+            p_numerical = not (int(tree.decision_type[parent])
+                               & kCategoricalMask)
+            # OppositeChildShouldBeUpdated (monotone_constraints.hpp:589).
+            # NOTE: the reference's comment claims categorical ancestors
+            # should still be descended, but its code returns false for
+            # them (the `else` branch); behavior parity follows the code.
+            should = p_numerical and not any(
+                f == inner and wr == is_right
+                for f, wr in zip(feats_up, was_right))
+            if should:
+                if mono_type != 0:
+                    left_c = int(tree.left_child[parent])
+                    right_c = int(tree.right_child[parent])
+                    curr_is_left = left_c == child_code
+                    opposite = right_c if curr_is_left else left_c
+                    update_max = (curr_is_left if mono_type < 0
+                                  else not curr_is_left)
+                    self._go_down(tree, opposite, feats_up, thr_up,
+                                  was_right, update_max,
+                                  split_feature_inner, split_threshold,
+                                  left_output, right_output, True, True,
+                                  is_numerical, leaf_has_candidate, out)
+                was_right.append(is_right)
+                thr_up.append(int(tree.threshold_in_bin[parent]))
+                feats_up.append(inner)
+            child_code = parent
+            parent = self.node_parent[parent]
+        return out
+
+    def _go_down(self, tree: Tree, node: int, feats_up, thr_up, was_right,
+                 update_max: bool, split_feature: int,
+                 split_threshold: int, left_output: float,
+                 right_output: float, use_left: bool, use_right: bool,
+                 split_is_numerical: bool, leaf_has_candidate,
+                 out: List[int]) -> None:
+        if node < 0:
+            leaf = ~node
+            if not leaf_has_candidate(leaf):
+                return
+            if use_left and use_right:
+                lo, hi = sorted((left_output, right_output))
+            elif use_right:
+                lo = hi = right_output
+            else:
+                lo = hi = left_output
+            emin, emax = self.entries[leaf]
+            # UpdateMin/MaxAndReturnBoolIfChanged
+            # (monotone_constraints.hpp:74-88)
+            if update_max:
+                if lo < emax:
+                    self.entries[leaf] = (emin, lo)
+                    out.append(leaf)
+            else:
+                if hi > emin:
+                    self.entries[leaf] = (hi, emax)
+                    out.append(leaf)
+            return
+        # ShouldKeepGoingLeftRight (monotone_constraints.hpp:806)
+        inner = int(tree.split_feature_inner[node])
+        thr = int(tree.threshold_in_bin[node])
+        n_numerical = not (int(tree.decision_type[node])
+                           & kCategoricalMask)
+        keep_left = keep_right = True
+        if n_numerical:
+            for f, t, wr in zip(feats_up, thr_up, was_right):
+                if f == inner:
+                    if thr >= t and not wr:
+                        keep_right = False
+                    if thr <= t and wr:
+                        keep_left = False
+        use_left_for_right = True
+        use_right_for_left = True
+        if n_numerical and inner == split_feature and split_is_numerical:
+            if thr >= split_threshold:
+                use_left_for_right = False
+            if thr <= split_threshold:
+                use_right_for_left = False
+        if keep_left:
+            self._go_down(tree, int(tree.left_child[node]), feats_up,
+                          thr_up, was_right, update_max, split_feature,
+                          split_threshold, left_output, right_output,
+                          use_left, use_right and use_right_for_left,
+                          split_is_numerical, leaf_has_candidate, out)
+        if keep_right:
+            self._go_down(tree, int(tree.right_child[node]), feats_up,
+                          thr_up, was_right, update_max, split_feature,
+                          split_threshold, left_output, right_output,
+                          use_left and use_left_for_right, use_right,
+                          split_is_numerical, leaf_has_candidate, out)

@@ -60,7 +60,12 @@ from ..utils import log, next_pow2 as _next_pow2
 
 _NEG_INF = -jnp.inf
 _MIN_BUCKET = 256
-_MAX_BATCH = 32
+# Splits per device dispatch cap. Each batch costs one host round-trip
+# (~27 ms through the TPU tunnel, measured round 3); with the Pallas
+# histogram kernel a split step is ≲1 ms at typical gather sizes, so
+# larger batches trade a little wasted compute (stale gather size S) for
+# far fewer syncs: ~12 dispatches/tree at 255 leaves.
+_MAX_BATCH = 64
 
 
 class GrowState(NamedTuple):
@@ -325,7 +330,7 @@ def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
                 valid, mask_left, mask_right, meta, params, btab, *,
                 S: int, B: int, Bg: int, bundled: bool, max_depth: int,
                 extra_trees: bool, children_allowed=None,
-                rand_seed=0) -> GrowState:
+                rand_seed=0, pen_left=None, pen_right=None) -> GrowState:
     """Apply one split (already chosen: ``rec`` at ``leaf``) and scan both
     children. Shared by the per-split and batched paths.
     ``children_allowed`` None means: derive from device leaf_depth."""
@@ -377,7 +382,8 @@ def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
         state.cand_left_max[leaf],
         parent_output=rec.left_output,
         rand_bins=_maybe_rand_bins(extra_trees, rand_seed, 2 * new_leaf,
-                                   meta, params))
+                                   meta, params),
+        gain_penalty=pen_left, leaf_depth=child_depth)
     right_info = find_best_split(
         hist_right, rec.right_sum_grad, rec.right_sum_hess,
         rec.right_count, rec.right_total_count, meta, params,
@@ -385,7 +391,8 @@ def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
         state.cand_right_max[leaf],
         parent_output=rec.right_output,
         rand_bins=_maybe_rand_bins(extra_trees, rand_seed,
-                                   2 * new_leaf + 1, meta, params))
+                                   2 * new_leaf + 1, meta, params),
+        gain_penalty=pen_right, leaf_depth=child_depth)
 
     state = state._replace(leaf_of_row=leaf_of_row, hists=hists,
                            leaf_depth=leaf_depth)
@@ -411,7 +418,8 @@ def _root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
             hist, sums[0], sums[1], sums[2], sums[3], meta, params,
             feature_mask, parent_output=parent_out,
             rand_bins=_maybe_rand_bins(extra_trees, rand_seed, 0, meta,
-                                       params))
+                                       params),
+            leaf_depth=jnp.int32(0))
         state = make_root_state(gh, hist, leaf_of_row0, info, L, F, B,
                                 children_allowed)
         return state, _record_at(state, 0)
@@ -439,6 +447,150 @@ def _step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
         return state, _record_at(state, best)
 
     return jax.jit(step, donate_argnums=(1,))
+
+
+def _cegb_penalty(params, count, used, coupled, unfetched, lazy):
+    """Per-feature CEGB gain penalty for scanning one leaf (reference:
+    CostEfficientGradientBoosting::DeltaGain,
+    cost_effective_gradient_boosting.hpp:80-99): split penalty scaled by
+    leaf size + coupled penalty for model-new features + lazy per-row
+    fetch cost for rows that have not used the feature yet."""
+    pen = params.cegb_penalty_split * count + coupled * (~used)
+    if lazy is not None:
+        pen = pen + lazy * unfetched
+    return params.cegb_tradeoff * pen
+
+
+@functools.lru_cache(maxsize=None)
+def _cegb_root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
+                         has_lazy: bool):
+    def root(bins, gh, leaf_of_row0, feature_mask, children_allowed,
+             used, fetched, coupled, lazy, meta, params, btab):
+        F = meta.num_bin.shape[0]
+        sums = jnp.sum(gh, axis=0)
+        hist = _leaf_histogram(bins, gh, meta, btab, B=B, Bg=Bg,
+                               bundled=bundled, totals=sums)
+        parent_out = calculate_leaf_output(sums[0], sums[1], params)
+        if has_lazy:
+            in_rows = (leaf_of_row0 >= 0).astype(jnp.float32)
+            unfetched = jnp.einsum("r,rf->f", in_rows, 1.0 - fetched)
+        else:
+            unfetched, lazy = None, None
+        pen = _cegb_penalty(params, sums[3], used, coupled, unfetched,
+                            lazy)
+        info = find_best_split(
+            hist, sums[0], sums[1], sums[2], sums[3], meta, params,
+            feature_mask, parent_output=parent_out, gain_penalty=pen)
+        state = make_root_state(gh, hist, leaf_of_row0, info, L, F, B,
+                                children_allowed)
+        return state, _record_at(state, 0)
+
+    return jax.jit(root)
+
+
+@functools.lru_cache(maxsize=None)
+def _cegb_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
+                         has_lazy: bool):
+    """Per-split CEGB step: applies the pending split, updates the
+    used-features vector and (lazy mode) the per-(row, feature) fetched
+    matrix, and scans both children with penalized gains (reference:
+    SerialTreeLearner::Split + CEGB UpdateLeafBestSplits,
+    cost_effective_gradient_boosting.hpp:101). Divergence from the
+    reference: candidates stored for *other* leaves are not retroactively
+    refunded when a coupled feature first becomes used — they keep the
+    penalty until re-scanned as children (pessimistic ordering only)."""
+    def step(bins, state: GrowState, leaf, new_leaf, children_allowed,
+             feature_mask, used, fetched, coupled, lazy, meta, params,
+             btab):
+        rec = _record_at(state, leaf)
+        f = jnp.maximum(rec.feature, 0)
+        used2 = used.at[f].set(True)
+        on_leaf = state.leaf_of_row == leaf
+        if has_lazy:
+            # every row that flowed through the new split node has now
+            # "fetched" feature f (both children)
+            fetched2 = jnp.maximum(
+                fetched,
+                on_leaf.astype(fetched.dtype)[:, None]
+                * jax.nn.one_hot(f, fetched.shape[1],
+                                 dtype=fetched.dtype))
+            col = _partition_col(bins, f, meta, btab, bundled)
+            gl = _go_left_by_bin(col, rec.threshold_bin, rec.default_left,
+                                 meta.missing_type[f],
+                                 meta.num_bin[f] - 1, meta.zero_bin[f],
+                                 rec.is_categorical, rec.cat_mask)
+            unf = 1.0 - fetched2
+            unf_left = jnp.einsum(
+                "r,rf->f", (on_leaf & gl).astype(jnp.float32), unf)
+            unf_right = jnp.einsum(
+                "r,rf->f", (on_leaf & ~gl).astype(jnp.float32), unf)
+        else:
+            fetched2 = fetched
+            unf_left = unf_right = None
+            lazy = None
+        pen_l = _cegb_penalty(params, rec.left_total_count, used2,
+                              coupled, unf_left, lazy)
+        pen_r = _cegb_penalty(params, rec.right_total_count, used2,
+                              coupled, unf_right, lazy)
+        state = _split_body(bins, state, rec, leaf, new_leaf,
+                            jnp.asarray(True), feature_mask, feature_mask,
+                            meta, params, btab, S=S, B=B, Bg=Bg,
+                            bundled=bundled, max_depth=0,
+                            extra_trees=False,
+                            children_allowed=children_allowed,
+                            pen_left=pen_l, pen_right=pen_r)
+        best = jnp.argmax(state.gain).astype(jnp.int32)
+        return state, _record_at(state, best), used2, fetched2
+
+    return jax.jit(step, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=None)
+def _mono_step_fn_cached(S: int, B: int, Bg: int, bundled: bool):
+    """Per-split step for monotone_constraints_method=intermediate: the
+    children's output bounds come from the host tracker (sibling-output
+    based, monotone_constraints.hpp:543) instead of the mid-point rule
+    baked into the stored candidate."""
+    def step(bins, state: GrowState, leaf, new_leaf, children_allowed,
+             feature_mask, lmin, lmax, rmin, rmax, meta, params, btab):
+        state = state._replace(
+            cand_left_min=state.cand_left_min.at[leaf].set(lmin),
+            cand_left_max=state.cand_left_max.at[leaf].set(lmax),
+            cand_right_min=state.cand_right_min.at[leaf].set(rmin),
+            cand_right_max=state.cand_right_max.at[leaf].set(rmax))
+        rec = _record_at(state, leaf)
+        state = _split_body(bins, state, rec, leaf, new_leaf,
+                            jnp.asarray(True), feature_mask, feature_mask,
+                            meta, params, btab, S=S, B=B, Bg=Bg,
+                            bundled=bundled, max_depth=0,
+                            extra_trees=False,
+                            children_allowed=children_allowed)
+        best = jnp.argmax(state.gain).astype(jnp.int32)
+        return state, _record_at(state, best), state.gain
+
+    return jax.jit(step, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=None)
+def _rescan_fn_cached(B: int):
+    """Recompute one leaf's best-split candidate from its stored
+    histogram under tightened output bounds (reference:
+    SerialTreeLearner::RecomputeBestSplitForLeaf,
+    serial_tree_learner.cpp:800)."""
+    def rescan(state: GrowState, leaf, sg, sh, c, tc, vmin, vmax, depth,
+               allowed, feature_mask, meta, params, btab):
+        hist = state.hists[leaf]
+        own = calculate_leaf_output(sg, sh, params)
+        parent_out = jnp.where(params.path_smooth > 1e-10, own, 0.0)
+        info = find_best_split(hist, sg, sh, c, tc, meta, params,
+                               feature_mask, vmin, vmax,
+                               parent_output=parent_out,
+                               leaf_depth=depth)
+        state = _store_info(state, leaf, info, allowed)
+        best = jnp.argmax(state.gain).astype(jnp.int32)
+        return state, _record_at(state, best), state.gain
+
+    return jax.jit(rescan, donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=None)
@@ -576,6 +728,83 @@ class SerialTreeLearner:
         self._root_fn = _root_fn_cached(self.L, self.B, self.Bg,
                                         self._bundled, self._extra_trees)
         self._forced = self._load_forced_splits(config)
+        self._init_cegb(config)
+        self._init_monotone(config)
+
+    def _init_monotone(self, config) -> None:
+        """intermediate/advanced monotone methods route through the
+        host-tracked stepwise path (reference: the LeafConstraintsBase
+        hierarchy, monotone_constraints.hpp; advanced degrades to
+        intermediate — its per-threshold cumulative constraints are not
+        implemented)."""
+        self._mono_tracker = None
+        method = str(config.monotone_constraints_method)
+        mc = self.dataset.monotone_constraints
+        has_mono = mc is not None and any(int(v) != 0 for v in mc)
+        if not has_mono or method == "basic":
+            return
+        if self._cegb_enabled:
+            log.warning("CEGB takes precedence over "
+                        "monotone_constraints_method=%s; monotone "
+                        "constraints run in basic mode" % method)
+            return
+        if self._extra_trees:
+            log.warning("extra_trees is ignored under "
+                        "monotone_constraints_method=%s" % method)
+        if method == "advanced":
+            log.warning("monotone_constraints_method=advanced is not "
+                        "implemented; using intermediate")
+        from .monotone import IntermediateMonotoneTracker
+        # dataset.monotone_constraints is already inner-feature ordered
+        mono_inner = np.zeros(self.Fp, dtype=np.int8)
+        mono_inner[:self.F] = np.asarray(mc, dtype=np.int8)[:self.F]
+        self._mono_tracker = IntermediateMonotoneTracker(self.L,
+                                                         mono_inner)
+
+    # ------------------------------------------------------------------
+    def _init_cegb(self, config) -> None:
+        """CEGB setup (reference: CostEfficientGradientBoosting::IsEnable
+        + Init, cost_effective_gradient_boosting.hpp:27-68). The
+        used-features vector and (lazy mode) the per-(row, feature)
+        fetched matrix persist across trees, like the reference's
+        is_feature_used_in_split_ / feature_used_in_data_ members."""
+        coupled = list(config.cegb_penalty_feature_coupled or [])
+        lazy = list(config.cegb_penalty_feature_lazy or [])
+        self._cegb_enabled = (config.cegb_tradeoff < 1.0
+                              or config.cegb_penalty_split > 0.0
+                              or bool(coupled) or bool(lazy))
+        if not self._cegb_enabled:
+            return
+        if self._extra_trees:
+            log.warning("extra_trees is ignored when CEGB is enabled")
+        n_total = self.dataset.num_total_features
+        for name, vec in (("cegb_penalty_feature_coupled", coupled),
+                          ("cegb_penalty_feature_lazy", lazy)):
+            if vec and len(vec) != n_total:
+                log.fatal("%s should be the same size as feature number "
+                          "(%d vs %d)" % (name, len(vec), n_total))
+
+        def to_inner(vec):
+            out = np.zeros(self.Fp, dtype=np.float32)
+            if vec:
+                for j in range(self.F):
+                    out[j] = vec[self.dataset.real_feature_index(j)]
+            return jnp.asarray(out)
+
+        self._cegb_coupled = to_inner(coupled)
+        self._cegb_lazy = to_inner(lazy)
+        self._cegb_has_lazy = bool(lazy) and any(v != 0 for v in lazy)
+        self._cegb_used = jnp.zeros(self.Fp, dtype=bool)
+        if self._cegb_has_lazy:
+            if self.R * self.Fp > 3 * 10**8:
+                log.warning("cegb_penalty_feature_lazy tracks a "
+                            "[rows x features] matrix (%.1f GB)"
+                            % (self.R * self.Fp * 4 / 2**30))
+            self._cegb_fetched = jnp.zeros((self.R, self.Fp),
+                                           dtype=jnp.float32)
+        else:
+            self._cegb_fetched = jnp.zeros((1, self.Fp),
+                                           dtype=jnp.float32)
 
     # ------------------------------------------------------------------
     def _sample_features(self) -> jnp.ndarray:
@@ -672,12 +901,12 @@ class SerialTreeLearner:
                                  self.max_depth, self._extra_trees), kb)
 
     def _batch_k(self, S: int) -> int:
-        """Steps per dispatch: aim for ~2R gathered rows per batch so early
+        """Steps per dispatch: aim for ~4R gathered rows per batch so early
         (large-S) batches stay short while deep-tree batches amortize the
         host round-trip over many cheap steps. Derived from the padded row
         count R (not N) so the (S, kb) pair — and thus the compiled batch
         variant — is shared across datasets of similar size."""
-        return int(np.clip((2 * self.R) // max(S, 1), 1, _MAX_BATCH))
+        return int(np.clip((4 * self.R) // max(S, 1), 1, _MAX_BATCH))
 
     def _bucket(self, count: float) -> int:
         # Small data (one pad block): a single canonical gather size —
@@ -772,6 +1001,13 @@ class SerialTreeLearner:
         self._tree_idx += 1
         rand_seed = jnp.int32(
             (self._extra_seed + 7919 * self._tree_idx) & 0x7FFFFFFF)
+        if self._cegb_enabled:
+            state = self._train_cegb(tree, gh, feature_mask)
+            return tree, state.leaf_of_row[:self.N]
+        if self._mono_tracker is not None:
+            state = self._train_monotone(tree, gh, feature_mask,
+                                         rand_seed)
+            return tree, state.leaf_of_row[:self.N]
         state, rec = self._root_fn(self.bins, gh, self._leaf_of_row0,
                                    feature_mask, self._splittable(0),
                                    rand_seed, self.meta, self.params,
@@ -823,6 +1059,127 @@ class SerialTreeLearner:
                 next_leaf += 1
             if stop:
                 break
+        return state
+
+    def _train_cegb(self, tree: Tree, gh, feature_mask) -> GrowState:
+        """CEGB growth: one host round-trip per split so penalties track
+        the evolving used/fetched state (reference: the DeltaGain calls
+        inside FindBestSplitsFromHistograms,
+        serial_tree_learner.cpp:375+)."""
+        if self._forced is not None or self._constraint_groups is not None:
+            log.warning("CEGB runs without forced splits / per-node "
+                        "feature masks")
+        root = _cegb_root_fn_cached(self.L, self.B, self.Bg,
+                                    self._bundled, self._cegb_has_lazy)
+        state, rec = root(self.bins, gh, self._leaf_of_row0, feature_mask,
+                          self._splittable(0), self._cegb_used,
+                          self._cegb_fetched, self._cegb_coupled,
+                          self._cegb_lazy, self.meta, self.params,
+                          self._btab)
+        pending = jax.device_get(rec)
+        for k in range(1, self.L):
+            if not record_is_valid(pending):
+                break
+            leaf = int(pending.leaf)
+            apply_split_record(tree, self.dataset, pending)
+            children_allowed = self._splittable(int(tree.leaf_depth[leaf]))
+            smaller = min(float(pending.left_total_count),
+                          float(pending.right_total_count))
+            S = self._bucket(smaller)
+            fn = _cegb_step_fn_cached(S, self.B, self.Bg, self._bundled,
+                                      self._cegb_has_lazy)
+            state, rec, self._cegb_used, self._cegb_fetched = fn(
+                self.bins, state, jnp.int32(leaf), jnp.int32(k),
+                jnp.asarray(children_allowed), feature_mask,
+                self._cegb_used, self._cegb_fetched, self._cegb_coupled,
+                self._cegb_lazy, self.meta, self.params, self._btab)
+            pending = jax.device_get(rec)
+        return state
+
+    def _train_monotone(self, tree: Tree, gh, feature_mask,
+                        rand_seed) -> GrowState:
+        """monotone_constraints_method=intermediate growth: stepwise with
+        host-tracked bounds + contiguous-leaf rescans (reference:
+        SerialTreeLearner::Split → constraints_->Update →
+        RecomputeBestSplitForLeaf, serial_tree_learner.cpp:702-710)."""
+        tracker = self._mono_tracker
+        tracker.reset()
+        if self._forced is not None:
+            log.warning("forced splits are ignored under "
+                        "monotone_constraints_method=intermediate")
+        if self._constraint_groups is not None:
+            log.warning("interaction constraints are ignored under "
+                        "monotone_constraints_method=intermediate")
+        state, rec = self._root_fn(self.bins, gh, self._leaf_of_row0,
+                                   feature_mask, self._splittable(0),
+                                   rand_seed, self.meta, self.params,
+                                   self._btab)
+        pending = jax.device_get(rec)
+        gains_h = None
+        leaf_sums: dict = {}
+        rescan = _rescan_fn_cached(self.B)
+        for k in range(1, self.L):
+            if not record_is_valid(pending):
+                break
+            leaf = int(pending.leaf)
+            f_inner = int(pending.feature)
+            mono_type = int(tracker.mono[f_inner])
+            if leaf == 0 and 0 not in leaf_sums:
+                leaf_sums[0] = (
+                    float(pending.left_sum_grad)
+                    + float(pending.right_sum_grad),
+                    float(pending.left_sum_hess)
+                    + float(pending.right_sum_hess),
+                    float(pending.left_count)
+                    + float(pending.right_count),
+                    float(pending.left_total_count)
+                    + float(pending.right_total_count))
+            tracker.before_split(tree, leaf, mono_type)
+            apply_split_record(tree, self.dataset, pending)
+            lo, ro = float(pending.left_output), \
+                float(pending.right_output)
+            bounds = tracker.child_bounds(leaf, mono_type, lo, ro)
+            tracker.apply_split(tree, leaf, k, bounds)
+            leaf_sums[leaf] = (float(pending.left_sum_grad),
+                               float(pending.left_sum_hess),
+                               float(pending.left_count),
+                               float(pending.left_total_count))
+            leaf_sums[k] = (float(pending.right_sum_grad),
+                            float(pending.right_sum_hess),
+                            float(pending.right_count),
+                            float(pending.right_total_count))
+            children_allowed = self._splittable(int(tree.leaf_depth[leaf]))
+            smaller = min(float(pending.left_total_count),
+                          float(pending.right_total_count))
+            S = self._bucket(smaller)
+            fn = _mono_step_fn_cached(S, self.B, self.Bg, self._bundled)
+            applied_tbin = int(pending.threshold_bin)
+            applied_numerical = not bool(pending.is_categorical)
+            state, rec, gains_d = fn(
+                self.bins, state, jnp.int32(leaf), jnp.int32(k),
+                jnp.asarray(children_allowed), feature_mask,
+                jnp.float32(bounds[0]), jnp.float32(bounds[1]),
+                jnp.float32(bounds[2]), jnp.float32(bounds[3]),
+                self.meta, self.params, self._btab)
+            pending, gains_h = jax.device_get((rec, gains_d))
+            # propagate to contiguous leaves + rescan them
+            upd = tracker.leaves_to_update(
+                tree, k, f_inner, applied_tbin, lo, ro,
+                applied_numerical,
+                lambda l: (l <= k and np.isfinite(gains_h[l])))
+            for l in upd:
+                emin, emax = tracker.entries[l]
+                sg, sh, c, tc = leaf_sums[l]
+                allowed_l = self._splittable(int(tree.leaf_depth[l]))
+                state, rec, gains_d = rescan(
+                    state, jnp.int32(l), jnp.float32(sg),
+                    jnp.float32(sh), jnp.float32(c), jnp.float32(tc),
+                    jnp.float32(emin), jnp.float32(emax),
+                    jnp.int32(tree.leaf_depth[l]),
+                    jnp.asarray(allowed_l), feature_mask, self.meta,
+                    self.params, self._btab)
+            if upd:
+                pending, gains_h = jax.device_get((rec, gains_d))
         return state
 
     def _train_stepwise(self, tree: Tree, state: GrowState, rec,

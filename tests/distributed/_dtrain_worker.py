@@ -1,0 +1,39 @@
+"""Full-boosting distributed worker (reference: dask.py _train_part —
+each worker trains the whole model on its shard, models agree). Spawned
+by tests/test_distributed_multiproc.py."""
+import sys
+
+import numpy as np
+
+
+def main() -> None:
+    rank = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    out = sys.argv[4]
+
+    import jax
+    jax.distributed.initialize("127.0.0.1:%s" % port, nproc, rank)
+
+    from lightgbm_tpu.parallel import dtrain
+
+    rng = np.random.RandomState(0)
+    n, f = 600, 5
+    X = rng.randn(n, f)
+    y = (X[:, 0] - 0.7 * X[:, 1] + 0.2 * rng.randn(n) > 0).astype(float)
+    lo, hi = rank * (n // nproc), (rank + 1) * (n // nproc)
+    booster = dtrain.train(
+        {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "bin_construct_sample_cnt": n, "verbosity": -1,
+         "learning_rate": 0.2},
+        X[lo:hi], y[lo:hi], num_boost_round=8)
+    pred = booster.predict(X)  # every process predicts the FULL data
+    with open(out + ".txt", "w") as fh:
+        fh.write(booster.model_to_string())
+    np.savez(out, pred=pred, n_trees=np.asarray(
+        [len(booster.inner.models)]))
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -231,14 +231,21 @@ class TestExtraTrees:
 
 
 class TestParamWarnings:
-    def test_cegb_warns(self, capsys):
+    def test_cegb_accepted_silently(self, capsys):
+        # CEGB is implemented now (tests/test_cegb.py); accepting its
+        # params must not warn
         from lightgbm_tpu.config import Config
-        Config.from_params({"cegb_tradeoff": 2.0, "verbosity": 1})
-        assert "CEGB" in capsys.readouterr().err
+        cfg = Config.from_params({"cegb_tradeoff": 2.0, "verbosity": 1})
+        assert cfg.cegb_tradeoff == 2.0
+        assert "CEGB" not in capsys.readouterr().err
 
-    def test_monotone_method_falls_back(self, capsys):
+    def test_monotone_methods_accepted(self, capsys):
         from lightgbm_tpu.config import Config
         cfg = Config.from_params({"monotone_constraints_method": "advanced",
                                   "verbosity": 1})
-        assert cfg.monotone_constraints_method == "basic"
+        # advanced degrades to intermediate at learner init, not here
+        assert cfg.monotone_constraints_method == "advanced"
+        cfg2 = Config.from_params({"monotone_constraints_method": "bogus",
+                                   "verbosity": 1})
+        assert cfg2.monotone_constraints_method == "basic"
         assert "monotone_constraints_method" in capsys.readouterr().err

@@ -1,0 +1,101 @@
+"""CEGB (cost-effective gradient boosting) tests — the analogue of the
+reference's tests/python_package_test/test_engine.py::test_cegb.
+Reference: src/treelearner/cost_effective_gradient_boosting.hpp."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=1500, seed=4):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 6)
+    # every feature mildly informative so CEGB penalties change choices
+    y = (X @ np.array([1.0, 0.8, 0.6, 0.5, 0.4, 0.3])
+         + 0.3 * rng.randn(n))
+    return X, y
+
+
+def _features_used(bst):
+    return set(np.nonzero(bst.feature_importance("split"))[0])
+
+
+def test_coupled_penalty_reduces_feature_set():
+    X, y = _data()
+    base_params = {"objective": "regression", "num_leaves": 15,
+                   "verbosity": -1, "min_data_in_leaf": 20}
+    bst = lgb.train(base_params, lgb.Dataset(X, label=y),
+                    num_boost_round=10)
+    used_base = _features_used(bst)
+
+    # heavy coupled penalty on features 1..5 → the model should
+    # concentrate on feature 0 (reference: DeltaGain coupled term)
+    pen = [0.0] + [1e6] * 5
+    bst2 = lgb.train(dict(base_params,
+                          cegb_penalty_feature_coupled=pen),
+                     lgb.Dataset(X, label=y), num_boost_round=10)
+    used_pen = _features_used(bst2)
+    assert used_pen == {0}
+    assert len(used_base) > 1  # the penalty, not the data, did it
+
+
+def test_split_penalty_prunes_tree():
+    X, y = _data()
+    params = {"objective": "regression", "num_leaves": 31,
+              "verbosity": -1, "min_data_in_leaf": 20}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    n_base = sum(t.num_leaves for t in bst.inner.models)
+
+    # per-data split penalty makes large-leaf splits expensive →
+    # fewer leaves (reference: cegb_penalty_split * num_data_in_leaf)
+    bst2 = lgb.train(dict(params, cegb_penalty_split=0.5),
+                     lgb.Dataset(X, label=y), num_boost_round=5)
+    n_pen = sum(t.num_leaves for t in bst2.inner.models)
+    assert n_pen < n_base
+
+    # an overwhelming penalty stops all splitting after boost-from-average
+    bst3 = lgb.train(dict(params, cegb_penalty_split=1e9),
+                     lgb.Dataset(X, label=y), num_boost_round=3)
+    assert all(t.num_leaves == 1 for t in bst3.inner.models)
+
+
+def test_lazy_penalty_trains_and_biases_reuse():
+    X, y = _data()
+    params = {"objective": "regression", "num_leaves": 15,
+              "verbosity": -1, "min_data_in_leaf": 20}
+    # lazy fetch cost on all features: still trains, and quality stays
+    # reasonable while the tree prefers re-using fetched features
+    bst = lgb.train(dict(params,
+                         cegb_penalty_feature_lazy=[1e-3] * 6),
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    pred = bst.predict(X)
+    resid = np.mean((pred - y) ** 2) / np.var(y)
+    assert resid < 0.5
+    # a crushing lazy penalty forbids any feature fetch → stump model
+    bst2 = lgb.train(dict(params,
+                          cegb_penalty_feature_lazy=[1e9] * 6),
+                     lgb.Dataset(X, label=y), num_boost_round=3)
+    assert all(t.num_leaves == 1 for t in bst2.inner.models)
+
+
+def test_tradeoff_scales_penalties():
+    X, y = _data()
+    params = {"objective": "regression", "num_leaves": 31,
+              "verbosity": -1, "min_data_in_leaf": 20,
+              "cegb_penalty_split": 0.5}
+    n_leaves = []
+    for tradeoff in (0.1, 1.0, 4.0):
+        bst = lgb.train(dict(params, cegb_tradeoff=tradeoff),
+                        lgb.Dataset(X, label=y), num_boost_round=5)
+        n_leaves.append(sum(t.num_leaves for t in bst.inner.models))
+    assert n_leaves[0] >= n_leaves[1] >= n_leaves[2]
+    assert n_leaves[0] > n_leaves[2]
+
+
+def test_no_cegb_params_means_normal_path():
+    X, y = _data()
+    params = {"objective": "regression", "num_leaves": 15,
+              "verbosity": -1, "min_data_in_leaf": 20}
+    a = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    b = lgb.train(dict(params, cegb_tradeoff=1.0, cegb_penalty_split=0.0),
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    np.testing.assert_allclose(a.predict(X), b.predict(X), rtol=1e-12)

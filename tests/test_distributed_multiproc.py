@@ -94,3 +94,58 @@ def test_two_process_tree_matches_single_process(tmp_path):
     full_leaf = np.asarray(part)
     np.testing.assert_array_equal(w[0]["local_leaf"], full_leaf[:400])
     np.testing.assert_array_equal(w[1]["local_leaf"], full_leaf[400:])
+
+
+_DTRAIN_WORKER = os.path.join(os.path.dirname(__file__), "distributed",
+                              "_dtrain_worker.py")
+
+
+@pytest.mark.slow
+def test_two_process_full_boosting_matches_single(tmp_path):
+    """Full distributed boosting (parallel/dtrain.py train) produces the
+    same model on both processes and tracks single-process lgb.train on
+    the full data (reference: test_dask.py model-equivalence pattern)."""
+    nproc = 2
+    port = _free_port()
+    outs = [str(tmp_path / ("d%d.npz" % r)) for r in range(nproc)]
+    procs = [subprocess.Popen(
+        [sys.executable, _DTRAIN_WORKER, str(r), str(nproc), str(port),
+         outs[r]],
+        env=_worker_env(2), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+        for r in range(nproc)]
+    logs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        logs.append(out)
+    for r, p in enumerate(procs):
+        assert p.returncode == 0, "worker %d failed:\n%s" % (r, logs[r])
+
+    w = [np.load(o) for o in outs]
+    # identical model text on both processes
+    s0 = open(outs[0] + ".txt").read()
+    s1 = open(outs[1] + ".txt").read()
+    assert s0 == s1
+    np.testing.assert_allclose(w[0]["pred"], w[1]["pred"], rtol=1e-12)
+
+    # equivalence with single-process training on the same full data
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(0)
+    n, f = 600, 5
+    X = rng.randn(n, f)
+    y = (X[:, 0] - 0.7 * X[:, 1] + 0.2 * rng.randn(n) > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "bin_construct_sample_cnt": n, "verbosity": -1,
+         "learning_rate": 0.2},
+        lgb.Dataset(X, label=y), num_boost_round=8)
+    pred_single = bst.predict(X)
+    np.testing.assert_allclose(w[0]["pred"], pred_single, rtol=5e-3,
+                               atol=5e-3)
+    # distributed model separates classes about as well
+    sep = w[0]["pred"][y == 1].mean() - w[0]["pred"][y == 0].mean()
+    assert sep > 0.5

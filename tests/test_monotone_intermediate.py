@@ -1,0 +1,107 @@
+"""Monotone constraint tests: intermediate method + monotone_penalty —
+the analogue of the reference's test_engine.py monotone tests
+(test_monotone_constraints, params_with_different_constraint_methods).
+Reference: src/treelearner/monotone_constraints.hpp."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=2000, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 3)
+    y = (3.0 * X[:, 0]                      # should be +1 monotone
+         - 2.0 * X[:, 1]                    # should be -1 monotone
+         + 0.5 * np.sin(8 * X[:, 2])        # unconstrained
+         + 0.1 * rng.randn(n))
+    return X, y
+
+
+def _is_monotone(bst, X, feature, sign, n_grid=30):
+    """Sweep the feature over its range for fixed other columns and check
+    prediction monotonicity (reference test pattern:
+    test_engine.py is_increasing/is_non_increasing checks)."""
+    rng = np.random.RandomState(0)
+    base = rng.rand(50, X.shape[1])
+    grid = np.linspace(0.01, 0.99, n_grid)
+    for row in base:
+        pts = np.tile(row, (n_grid, 1))
+        pts[:, feature] = grid
+        pred = bst.predict(pts)
+        diffs = np.diff(pred)
+        if sign > 0 and (diffs < -1e-10).any():
+            return False
+        if sign < 0 and (diffs > 1e-10).any():
+            return False
+    return True
+
+
+@pytest.mark.parametrize("method", ["basic", "intermediate"])
+def test_monotone_holds(method):
+    X, y = _data()
+    params = {"objective": "regression", "num_leaves": 31,
+              "verbosity": -1, "min_data_in_leaf": 20,
+              "monotone_constraints": [1, -1, 0],
+              "monotone_constraints_method": method}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=20)
+    assert _is_monotone(bst, X, 0, +1)
+    assert _is_monotone(bst, X, 1, -1)
+
+
+def test_intermediate_at_least_as_good_as_basic():
+    """The reference docs motivate intermediate as 'slightly slower but
+    better results'; check it does not regress the fit."""
+    X, y = _data()
+    scores = {}
+    for method in ("basic", "intermediate"):
+        params = {"objective": "regression", "num_leaves": 31,
+                  "verbosity": -1, "min_data_in_leaf": 20,
+                  "monotone_constraints": [1, -1, 0],
+                  "monotone_constraints_method": method}
+        bst = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=20)
+        scores[method] = float(np.mean((bst.predict(X) - y) ** 2))
+    assert scores["intermediate"] <= scores["basic"] * 1.1
+
+
+def test_advanced_falls_back_to_intermediate():
+    X, y = _data()
+    params = {"objective": "regression", "num_leaves": 15,
+              "verbosity": -1, "monotone_constraints": [1, -1, 0],
+              "monotone_constraints_method": "advanced"}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
+    assert _is_monotone(bst, X, 0, +1)
+
+
+def test_monotone_penalty_discourages_constrained_splits():
+    X, y = _data()
+    base = {"objective": "regression", "num_leaves": 31,
+            "verbosity": -1, "min_data_in_leaf": 20,
+            "monotone_constraints": [1, -1, 0]}
+    bst = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=5)
+    imp0 = bst.feature_importance("split")
+
+    bst2 = lgb.train(dict(base, monotone_penalty=2.0),
+                     lgb.Dataset(X, label=y), num_boost_round=5)
+    # penalty >= depth+1 crushes monotone-feature gains at depth < 2
+    # (reference: ComputeMonotoneSplitGainPenalty returns kEpsilon), so
+    # every root split must move to the unconstrained feature...
+    for t in bst2.inner.models:
+        assert t.split_feature[0] == 2
+    # ...whereas unpenalized trees root on a monotone feature here
+    assert bst.inner.models[0].split_feature[0] in (0, 1)
+    # the model still respects the constraints
+    assert _is_monotone(bst2, X, 0, +1)
+    assert _is_monotone(bst2, X, 1, -1)
+    assert imp0.sum() > 0
+
+
+def test_no_constraints_unaffected_by_method():
+    X, y = _data()
+    params = {"objective": "regression", "num_leaves": 15,
+              "verbosity": -1}
+    a = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    b = lgb.train(dict(params, monotone_constraints_method="intermediate"),
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    np.testing.assert_allclose(a.predict(X), b.predict(X), rtol=1e-12)
