@@ -1,0 +1,160 @@
+"""Streaming / chunked dataset construction.
+
+Equivalent of the reference's push/streaming ingestion surface used by
+external engines (reference: LGBM_DatasetInitStreaming /
+LGBM_DatasetPushRowsWithMetadata, include/LightGBM/c_api.h:176-299;
+ChunkedArray, include/LightGBM/utils/chunked_array.hpp): rows arrive in
+chunks whose total count may be unknown up front, metadata rides along,
+and the binned dataset materializes once at finalize.
+
+TPU-first redesign: the reference pushes rows into pre-built Bin
+columns (bin mappers already constructed from a sample). Here chunks
+are staged host-side in a ChunkedBuffer (amortized growth, no
+reallocation-copy of earlier chunks), the bin mappers are built at
+``finalize()`` from a reservoir sample of the streamed rows, and the
+one device transfer happens after binning — streaming into HBM row by
+row would serialize tiny transfers through the host.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..utils import log
+from .dataset import BinnedDataset
+
+
+class ChunkedBuffer:
+    """Append-only chunked 2-D float buffer (reference:
+    ChunkedArray<T>, include/LightGBM/utils/chunked_array.hpp — fixed
+    chunks, O(1) append, single coalesce at the end)."""
+
+    def __init__(self, num_cols: int, chunk_rows: int = 1 << 16,
+                 dtype=np.float64):
+        self.num_cols = int(num_cols)
+        self.chunk_rows = int(chunk_rows)
+        self.dtype = dtype
+        self._chunks: List[np.ndarray] = []
+        self._fill = 0  # rows used in the last chunk
+
+    def __len__(self) -> int:
+        if not self._chunks:
+            return 0
+        return (len(self._chunks) - 1) * self.chunk_rows + self._fill
+
+    def append_rows(self, rows: np.ndarray) -> None:
+        rows = np.atleast_2d(np.asarray(rows, dtype=self.dtype))
+        if rows.shape[1] != self.num_cols:
+            log.fatal("pushed chunk has %d columns, expected %d"
+                      % (rows.shape[1], self.num_cols))
+        pos = 0
+        n = rows.shape[0]
+        while pos < n:
+            if not self._chunks or self._fill == self.chunk_rows:
+                self._chunks.append(np.empty(
+                    (self.chunk_rows, self.num_cols), dtype=self.dtype))
+                self._fill = 0
+            take = min(n - pos, self.chunk_rows - self._fill)
+            self._chunks[-1][self._fill:self._fill + take] = \
+                rows[pos:pos + take]
+            self._fill += take
+            pos += take
+
+    def coalesce(self) -> np.ndarray:
+        """One contiguous [n, num_cols] array (reference:
+        ChunkedArray::coalesce_to)."""
+        if not self._chunks:
+            return np.empty((0, self.num_cols), dtype=self.dtype)
+        parts = self._chunks[:-1] + [self._chunks[-1][:self._fill]]
+        return np.concatenate(parts, axis=0)
+
+
+class StreamingDataset:
+    """Push-mode dataset builder (reference:
+    LGBM_DatasetInitStreaming → PushRows*WithMetadata →
+    LGBM_DatasetMarkFinished, c_api.h:176-330).
+
+    >>> sd = StreamingDataset(num_features=28, params={...})
+    >>> for chunk_X, chunk_y in stream:
+    ...     sd.push_rows(chunk_X, label=chunk_y)
+    >>> ds = sd.finalize()            # BinnedDataset, device-resident
+    """
+
+    def __init__(self, num_features: int,
+                 params: Optional[dict] = None,
+                 chunk_rows: int = 1 << 16,
+                 has_weight: bool = False,
+                 has_init_score: bool = False,
+                 has_group: bool = False):
+        self.config = Config.from_params(dict(params or {}))
+        self.num_features = int(num_features)
+        self._X = ChunkedBuffer(num_features, chunk_rows)
+        self._label = ChunkedBuffer(1, chunk_rows)
+        self._weight = ChunkedBuffer(1, chunk_rows) if has_weight else None
+        self._init_score = ChunkedBuffer(1, chunk_rows) \
+            if has_init_score else None
+        self._group: Optional[List[int]] = [] if has_group else None
+        self._finished = False
+
+    @property
+    def num_pushed(self) -> int:
+        return len(self._X)
+
+    def push_rows(self, X: np.ndarray,
+                  label: Optional[Sequence[float]] = None,
+                  weight: Optional[Sequence[float]] = None,
+                  init_score: Optional[Sequence[float]] = None,
+                  group: Optional[Sequence[int]] = None) -> None:
+        """Append a chunk of rows plus aligned metadata (reference:
+        LGBM_DatasetPushRowsByCSRWithMetadata semantics — metadata
+        arrives with the rows, not afterwards)."""
+        if self._finished:
+            log.fatal("push_rows after finalize()")
+        X = np.atleast_2d(np.asarray(X, dtype=np.float64))
+        self._X.append_rows(X)
+        if label is not None:
+            self._label.append_rows(
+                np.asarray(label, dtype=np.float64).reshape(-1, 1))
+        if weight is not None:
+            if self._weight is None:
+                log.fatal("weight pushed but has_weight=False")
+            self._weight.append_rows(
+                np.asarray(weight, dtype=np.float64).reshape(-1, 1))
+        if init_score is not None:
+            if self._init_score is None:
+                log.fatal("init_score pushed but has_init_score=False")
+            self._init_score.append_rows(
+                np.asarray(init_score, dtype=np.float64).reshape(-1, 1))
+        if group is not None:
+            if self._group is None:
+                log.fatal("group pushed but has_group=False")
+            self._group.extend(int(g) for g in np.atleast_1d(group))
+
+    def finalize(self, reference: Optional[BinnedDataset] = None,
+                 **kw) -> BinnedDataset:
+        """Coalesce chunks, build bin mappers, bin, move to device
+        (reference: LGBM_DatasetMarkFinished → FinishLoad)."""
+        if self._finished:
+            log.fatal("finalize() called twice")
+        self._finished = True
+        X = self._X.coalesce()
+        n = X.shape[0]
+        if n == 0:
+            log.fatal("no rows pushed before finalize()")
+        label = self._label.coalesce()[:, 0] if len(self._label) else None
+        if label is not None and len(label) != n:
+            log.fatal("pushed %d labels for %d rows" % (len(label), n))
+        weight = (self._weight.coalesce()[:, 0]
+                  if self._weight is not None and len(self._weight)
+                  else None)
+        init_score = (self._init_score.coalesce()[:, 0]
+                      if self._init_score is not None
+                      and len(self._init_score) else None)
+        group = (np.asarray(self._group, dtype=np.int32)
+                 if self._group else None)
+        return BinnedDataset.from_matrix(
+            X, self.config, label=label, weights=weight,
+            init_score=init_score, group=group, reference=reference,
+            **kw)

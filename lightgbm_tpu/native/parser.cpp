@@ -105,8 +105,8 @@ int ParseDense(const char* path, char delim, int skip_rows,
       long n_fields = 1;
       for (const char* c = p; c < stripped; ++c)
         if (*c == delim) ++n_fields;
-      if (n_fields > cols) return 2;  // ragged (over-long) row: fail
-                                      // loudly like the numpy fallback
+      if (n_fields != cols) return 2;  // ragged row (either direction):
+                                       // fail like the numpy fallback
       const char* field = p;
       for (const char* c = p; c <= stripped && col < cols; ++c) {
         if (c == stripped || *c == delim) {
@@ -165,7 +165,8 @@ int ParseLibSVM(const char* path, double** out, double** labels,
         if (c >= line_end) break;
         char* colon_end = nullptr;
         long idx = std::strtol(c, &colon_end, 10);
-        if (colon_end == c || colon_end >= line_end || *colon_end != ':')
+        if (colon_end == c || colon_end >= line_end || *colon_end != ':'
+            || idx < 0)  // negative index would write before the buffer
           break;
         c = colon_end + 1;
         double v = std::strtod(c, &parse_end);

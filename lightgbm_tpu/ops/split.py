@@ -253,7 +253,8 @@ def find_best_split(hist: jnp.ndarray,
                     parent_output=None,
                     rand_bins=None,
                     gain_penalty=None,
-                    leaf_depth=None) -> SplitInfo:
+                    leaf_depth=None,
+                    has_categorical: bool = True) -> SplitInfo:
     """Scan a leaf histogram for the best (feature, threshold) pair.
 
     Parameters
@@ -265,6 +266,10 @@ def find_best_split(hist: jnp.ndarray,
     params : SplitParams scalars
     feature_mask : bool[F] — feature_fraction / interaction-constraint mask
       (reference: src/treelearner/col_sampler.hpp)
+    has_categorical : STATIC — when the dataset has no categorical
+      features the one-hot/sorted-subset scans (two argsorts plus a
+      sequential 256-step lax.scan) are compiled out entirely; they are
+      dead weight in every split step of an all-numerical dataset.
     """
     F, B, _ = hist.shape
     g, h, c, tc = hist[..., 0], hist[..., 1], hist[..., 2], hist[..., 3]
@@ -344,104 +349,105 @@ def find_best_split(hist: jnp.ndarray,
     # elsewhere so argmax tie-breaking is deterministic.
     gain_l = jnp.where(is_nan_missing[:, None], gain_l, _NEG_INF)
 
-    # ---------------- categorical scans ----------------
-    # reference: FindBestThresholdCategoricalInner
-    # (src/treelearner/feature_histogram.hpp:278-520). Candidate bins are
-    # 1..num_bin-1 (bin 0 = NaN/other always routes right).
     kEps = 1e-15
-    cat_bin_ok = ((bin_ids >= 1) & (bin_ids < num_bin)
-                  & is_cat[:, None] & feature_mask[:, None])     # [F, B]
-    sum_g_ = sum_grad
-    sum_h_ = sum_hess
-    sum_c_ = sum_count
+    if has_categorical:
+        # ---------------- categorical scans ----------------
+        # reference: FindBestThresholdCategoricalInner
+        # (src/treelearner/feature_histogram.hpp:278-520). Candidate bins are
+        # 1..num_bin-1 (bin 0 = NaN/other always routes right).
+        cat_bin_ok = ((bin_ids >= 1) & (bin_ids < num_bin)
+                      & is_cat[:, None] & feature_mask[:, None])     # [F, B]
+        sum_g_ = sum_grad
+        sum_h_ = sum_hess
+        sum_c_ = sum_count
 
-    # one-hot mode (num_bin <= max_cat_to_onehot; plain lambda_l2)
-    oh_ok = (cat_bin_ok & meta.use_onehot[:, None]
-             & (c >= params.min_data_in_leaf)
-             & (h >= params.min_sum_hessian_in_leaf)
-             & ((sum_c_ - c) >= params.min_data_in_leaf)
-             & ((sum_h_ - h - kEps)
-                >= params.min_sum_hessian_in_leaf))
-    if rand_bins is not None:
-        oh_ok = oh_ok & (bin_ids == rand_bins[1][:, None])
-    gain_oh = bounded_gain(g, h + kEps, c) \
-        + bounded_gain(sum_g_ - g, sum_h_ - h - kEps, sum_c_ - c)
-    gain_oh = jnp.where(oh_ok, gain_oh, _NEG_INF)
+        # one-hot mode (num_bin <= max_cat_to_onehot; plain lambda_l2)
+        oh_ok = (cat_bin_ok & meta.use_onehot[:, None]
+                 & (c >= params.min_data_in_leaf)
+                 & (h >= params.min_sum_hessian_in_leaf)
+                 & ((sum_c_ - c) >= params.min_data_in_leaf)
+                 & ((sum_h_ - h - kEps)
+                    >= params.min_sum_hessian_in_leaf))
+        if rand_bins is not None:
+            oh_ok = oh_ok & (bin_ids == rand_bins[1][:, None])
+        gain_oh = bounded_gain(g, h + kEps, c) \
+            + bounded_gain(sum_g_ - g, sum_h_ - h - kEps, sum_c_ - c)
+        gain_oh = jnp.where(oh_ok, gain_oh, _NEG_INF)
 
-    # sorted-subset mode (l2 += cat_l2; sort by g/(h+cat_smooth))
-    cat_l2 = params.lambda_l2 + params.cat_l2
-    sort_elig = (cat_bin_ok & ~meta.use_onehot[:, None]
-                 & (c >= params.cat_smooth))                     # [F, B]
-    used_bin = jnp.sum(sort_elig, axis=1).astype(jnp.int32)      # [F]
-    ratio = jnp.where(sort_elig, g / (h + params.cat_smooth), jnp.inf)
-    order = jnp.argsort(ratio, axis=1, stable=True)              # [F, B]
-    rank = jnp.argsort(order, axis=1, stable=True) \
-        .astype(jnp.int32)                                       # [F, B]
-    sg_s = jnp.take_along_axis(g, order, axis=1)
-    sh_s = jnp.take_along_axis(h, order, axis=1)
-    sc_s = jnp.take_along_axis(c, order, axis=1)
-    stc_s = jnp.take_along_axis(tc, order, axis=1)
-    max_num_cat = jnp.minimum(params.max_cat_threshold,
-                              (used_bin + 1) // 2)               # [F]
+        # sorted-subset mode (l2 += cat_l2; sort by g/(h+cat_smooth))
+        cat_l2 = params.lambda_l2 + params.cat_l2
+        sort_elig = (cat_bin_ok & ~meta.use_onehot[:, None]
+                     & (c >= params.cat_smooth))                     # [F, B]
+        used_bin = jnp.sum(sort_elig, axis=1).astype(jnp.int32)      # [F]
+        ratio = jnp.where(sort_elig, g / (h + params.cat_smooth), jnp.inf)
+        order = jnp.argsort(ratio, axis=1, stable=True)              # [F, B]
+        rank = jnp.argsort(order, axis=1, stable=True) \
+            .astype(jnp.int32)                                       # [F, B]
+        sg_s = jnp.take_along_axis(g, order, axis=1)
+        sh_s = jnp.take_along_axis(h, order, axis=1)
+        sc_s = jnp.take_along_axis(c, order, axis=1)
+        stc_s = jnp.take_along_axis(tc, order, axis=1)
+        max_num_cat = jnp.minimum(params.max_cat_threshold,
+                                  (used_bin + 1) // 2)               # [F]
 
-    def cat_dir_scan(sgd, shd, scd, stcd):
-        """Prefix scan in one direction over sorted bins; returns
-        per-prefix gains [F, B] plus prefix stats."""
-        lg = jnp.cumsum(sgd, axis=1)
-        lh = jnp.cumsum(shd, axis=1) + kEps
-        lc = jnp.cumsum(scd, axis=1)
-        ltc = jnp.cumsum(stcd, axis=1)
-        rg, rh, rc = sum_g_ - lg, sum_h_ - lh, sum_c_ - lc
-        idx = jnp.arange(B, dtype=jnp.int32)[None, :]
-        pos_ok = (idx < used_bin[:, None]) & (idx < max_num_cat[:, None])
-        cont = (lc < params.min_data_in_leaf) \
-            | (lh < params.min_sum_hessian_in_leaf)
-        brk = (~cont) & ((rc < params.min_data_in_leaf)
-                         | (rc < params.min_data_per_group)
-                         | (rh < params.min_sum_hessian_in_leaf))
-        # sequential min_data_per_group batching (reference
-        # feature_histogram.hpp:443-447): accumulate counts, evaluate
-        # only when the running group reaches min_data_per_group, then
-        # reset. lax.scan over the (<=256) bin positions.
-        def step(carry, xs):
-            cnt_cur, broken = carry
-            cnt_i, cont_i, brk_i, pos_i = xs
-            cnt_cur = cnt_cur + cnt_i
-            can_eval = (pos_i & ~broken & ~cont_i & ~brk_i
-                        & (cnt_cur >= params.min_data_per_group))
-            cnt_cur = jnp.where(can_eval, 0.0, cnt_cur)
-            broken = broken | (brk_i & pos_i)
-            return (cnt_cur, broken), can_eval
+        def cat_dir_scan(sgd, shd, scd, stcd):
+            """Prefix scan in one direction over sorted bins; returns
+            per-prefix gains [F, B] plus prefix stats."""
+            lg = jnp.cumsum(sgd, axis=1)
+            lh = jnp.cumsum(shd, axis=1) + kEps
+            lc = jnp.cumsum(scd, axis=1)
+            ltc = jnp.cumsum(stcd, axis=1)
+            rg, rh, rc = sum_g_ - lg, sum_h_ - lh, sum_c_ - lc
+            idx = jnp.arange(B, dtype=jnp.int32)[None, :]
+            pos_ok = (idx < used_bin[:, None]) & (idx < max_num_cat[:, None])
+            cont = (lc < params.min_data_in_leaf) \
+                | (lh < params.min_sum_hessian_in_leaf)
+            brk = (~cont) & ((rc < params.min_data_in_leaf)
+                             | (rc < params.min_data_per_group)
+                             | (rh < params.min_sum_hessian_in_leaf))
+            # sequential min_data_per_group batching (reference
+            # feature_histogram.hpp:443-447): accumulate counts, evaluate
+            # only when the running group reaches min_data_per_group, then
+            # reset. lax.scan over the (<=256) bin positions.
+            def step(carry, xs):
+                cnt_cur, broken = carry
+                cnt_i, cont_i, brk_i, pos_i = xs
+                cnt_cur = cnt_cur + cnt_i
+                can_eval = (pos_i & ~broken & ~cont_i & ~brk_i
+                            & (cnt_cur >= params.min_data_per_group))
+                cnt_cur = jnp.where(can_eval, 0.0, cnt_cur)
+                broken = broken | (brk_i & pos_i)
+                return (cnt_cur, broken), can_eval
 
-        (_, _), can_eval = jax.lax.scan(
-            step,
-            (jnp.zeros(F), jnp.zeros(F, dtype=bool)),
-            (scd.T, cont.T, brk.T, pos_ok.T))
-        can_eval = can_eval.T                                    # [F, B]
-        gains = bounded_gain(lg, lh, lc, cat_l2) \
-            + bounded_gain(rg, rh, rc, cat_l2)
-        return jnp.where(can_eval, gains, _NEG_INF), (lg, lh, lc, ltc)
+            (_, _), can_eval = jax.lax.scan(
+                step,
+                (jnp.zeros(F), jnp.zeros(F, dtype=bool)),
+                (scd.T, cont.T, brk.T, pos_ok.T))
+            can_eval = can_eval.T                                    # [F, B]
+            gains = bounded_gain(lg, lh, lc, cat_l2) \
+                + bounded_gain(rg, rh, rc, cat_l2)
+            return jnp.where(can_eval, gains, _NEG_INF), (lg, lh, lc, ltc)
 
-    gain_cs_f, stats_f = cat_dir_scan(sg_s, sh_s, sc_s, stc_s)
-    # reverse direction: prefixes from the high end of the sorted order,
-    # but only over the eligible (first used_bin) positions — roll the
-    # reversed arrays so eligible bins come first
-    def rev_eligible(a):
-        ar = jnp.flip(a, axis=1)
-        shift = B - used_bin                                    # [F]
-        idx = (jnp.arange(B, dtype=jnp.int32)[None, :]
-               + shift[:, None]) % B
-        return jnp.take_along_axis(ar, idx, axis=1)
+        gain_cs_f, stats_f = cat_dir_scan(sg_s, sh_s, sc_s, stc_s)
+        # reverse direction: prefixes from the high end of the sorted order,
+        # but only over the eligible (first used_bin) positions — roll the
+        # reversed arrays so eligible bins come first
+        def rev_eligible(a):
+            ar = jnp.flip(a, axis=1)
+            shift = B - used_bin                                    # [F]
+            idx = (jnp.arange(B, dtype=jnp.int32)[None, :]
+                   + shift[:, None]) % B
+            return jnp.take_along_axis(ar, idx, axis=1)
 
-    gain_cs_r, stats_r = cat_dir_scan(
-        rev_eligible(sg_s), rev_eligible(sh_s), rev_eligible(sc_s),
-        rev_eligible(stc_s))
-    if rand_bins is not None:
-        # extra_trees sorted-subset mode: only the random prefix length
-        # (reference: rand.NextInt(0, max_threshold), fh.hpp:402)
-        rs = rand_bins[2][:, None] == bin_ids
-        gain_cs_f = jnp.where(rs, gain_cs_f, _NEG_INF)
-        gain_cs_r = jnp.where(rs, gain_cs_r, _NEG_INF)
+        gain_cs_r, stats_r = cat_dir_scan(
+            rev_eligible(sg_s), rev_eligible(sh_s), rev_eligible(sc_s),
+            rev_eligible(stc_s))
+        if rand_bins is not None:
+            # extra_trees sorted-subset mode: only the random prefix length
+            # (reference: rand.NextInt(0, max_threshold), fh.hpp:402)
+            rs = rand_bins[2][:, None] == bin_ids
+            gain_cs_f = jnp.where(rs, gain_cs_f, _NEG_INF)
+            gain_cs_r = jnp.where(rs, gain_cs_r, _NEG_INF)
 
     # Parent-gain baseline, subtracted per variant BEFORE the argmax
     # (reference: min_gain_shift). Under path smoothing the numerical
@@ -464,9 +470,12 @@ def find_best_split(hist: jnp.ndarray,
     shift_num = parent_gain_num + params.min_gain_to_split
     shift_cat = parent_gain_cat + params.min_gain_to_split
 
-    gains = jnp.stack([gain_r - shift_num, gain_l - shift_num,
-                       gain_oh - shift_cat, gain_cs_f - shift_cat,
-                       gain_cs_r - shift_cat])
+    if has_categorical:
+        gains = jnp.stack([gain_r - shift_num, gain_l - shift_num,
+                           gain_oh - shift_cat, gain_cs_f - shift_cat,
+                           gain_cs_r - shift_cat])
+    else:
+        gains = jnp.stack([gain_r - shift_num, gain_l - shift_num])
     if gain_penalty is not None:
         # CEGB per-feature gain penalty (reference:
         # CostEfficientGradientBoosting::DeltaGain,
@@ -502,23 +511,27 @@ def find_best_split(hist: jnp.ndarray,
     lc_n = left_c[feature, tbin] + jnp.where(is_l, nan_c[feature], 0.0)
     ltc_n = left_tc[feature, tbin] + jnp.where(is_l, nan_tc[feature], 0.0)
 
-    winner_is_cat = variant >= 2
-    lg = jnp.select(
-        [variant <= 1, variant == 2, variant == 3, variant == 4],
-        [lg_n, g[feature, tbin], stats_f[0][feature, tbin],
-         stats_r[0][feature, tbin]])
-    lh = jnp.select(
-        [variant <= 1, variant == 2, variant == 3, variant == 4],
-        [lh_n, h[feature, tbin] + kEps, stats_f[1][feature, tbin],
-         stats_r[1][feature, tbin]])
-    lc = jnp.select(
-        [variant <= 1, variant == 2, variant == 3, variant == 4],
-        [lc_n, c[feature, tbin], stats_f[2][feature, tbin],
-         stats_r[2][feature, tbin]])
-    ltc = jnp.select(
-        [variant <= 1, variant == 2, variant == 3, variant == 4],
-        [ltc_n, tc[feature, tbin], stats_f[3][feature, tbin],
-         stats_r[3][feature, tbin]])
+    if has_categorical:
+        winner_is_cat = variant >= 2
+        lg = jnp.select(
+            [variant <= 1, variant == 2, variant == 3, variant == 4],
+            [lg_n, g[feature, tbin], stats_f[0][feature, tbin],
+             stats_r[0][feature, tbin]])
+        lh = jnp.select(
+            [variant <= 1, variant == 2, variant == 3, variant == 4],
+            [lh_n, h[feature, tbin] + kEps, stats_f[1][feature, tbin],
+             stats_r[1][feature, tbin]])
+        lc = jnp.select(
+            [variant <= 1, variant == 2, variant == 3, variant == 4],
+            [lc_n, c[feature, tbin], stats_f[2][feature, tbin],
+             stats_r[2][feature, tbin]])
+        ltc = jnp.select(
+            [variant <= 1, variant == 2, variant == 3, variant == 4],
+            [ltc_n, tc[feature, tbin], stats_f[3][feature, tbin],
+             stats_r[3][feature, tbin]])
+    else:
+        winner_is_cat = jnp.asarray(False)
+        lg, lh, lc, ltc = lg_n, lh_n, lc_n, ltc_n
     rg, rh, rc = sum_grad - lg, sum_hess - lh, sum_count - lc
     rtc = sum_total_count - ltc
 
@@ -531,20 +544,24 @@ def find_best_split(hist: jnp.ndarray,
                   (meta.missing_type[feature] == MissingType.ZERO)
                   & (meta.zero_bin[feature] <= tbin)))
 
-    # categorical left-bin mask: one-hot → {tbin}; sorted fwd → sorted
-    # rank <= tbin; sorted rev → the tbin+1 highest-ratio eligible bins
-    rk = rank[feature]                                           # [B]
-    ub = used_bin[feature]
-    mask_oh = jnp.arange(B, dtype=jnp.int32) == tbin
-    mask_fwd = rk <= tbin
-    mask_rev = (rk >= ub - 1 - tbin) & (rk < ub)
-    elig_row = sort_elig[feature]
-    cat_mask = jnp.select(
-        [variant == 2, variant == 3, variant == 4],
-        [mask_oh, mask_fwd & elig_row, mask_rev & elig_row],
-        jnp.zeros(B, dtype=bool))
-
-    out_l2 = jnp.where(variant >= 3, cat_l2, params.lambda_l2)
+    if has_categorical:
+        # categorical left-bin mask: one-hot → {tbin}; sorted fwd →
+        # sorted rank <= tbin; sorted rev → the tbin+1 highest-ratio
+        # eligible bins
+        rk = rank[feature]                                       # [B]
+        ub = used_bin[feature]
+        mask_oh = jnp.arange(B, dtype=jnp.int32) == tbin
+        mask_fwd = rk <= tbin
+        mask_rev = (rk >= ub - 1 - tbin) & (rk < ub)
+        elig_row = sort_elig[feature]
+        cat_mask = jnp.select(
+            [variant == 2, variant == 3, variant == 4],
+            [mask_oh, mask_fwd & elig_row, mask_rev & elig_row],
+            jnp.zeros(B, dtype=bool))
+        out_l2 = jnp.where(variant >= 3, cat_l2, params.lambda_l2)
+    else:
+        cat_mask = jnp.zeros(B, dtype=bool)
+        out_l2 = params.lambda_l2
     out_left = bounded_output(lg, lh, lc, out_l2)
     out_right = bounded_output(rg, rh, rc, out_l2)
     # children bounds (reference: BasicLeafConstraints::Update — the

@@ -114,6 +114,8 @@ class DataParallelTreeLearner:
         self.params = jax.device_put(SplitParams.from_config(config),
                                      self.rep_sharding)
         self._ff_rng = np.random.RandomState(config.feature_fraction_seed)
+        self._has_cat = bool(
+            np.asarray(self.meta.is_categorical).any())
         self._root_fn = None
         self._step_fn = None
         if getattr(config, "extra_trees", False):
@@ -166,7 +168,8 @@ class DataParallelTreeLearner:
         parent_out = calculate_leaf_output(sums[0], sums[1], self.params)
         info = find_best_split(hist, sums[0], sums[1], sums[2], sums[3],
                                self.meta, self.params, feature_mask,
-                               parent_output=parent_out)
+                               parent_output=parent_out,
+                               has_categorical=self._has_cat)
         leaf_of_row = self._initial_partition(gh)
         state = make_root_state(gh, hist, leaf_of_row, info, self.L,
                                 self.F, self.B, children_allowed,
@@ -204,12 +207,14 @@ class DataParallelTreeLearner:
             hist_left, state.left_sum_grad[leaf],
             state.left_sum_hess[leaf], lc, ltc, meta, params, mask_left,
             state.cand_left_min[leaf], state.cand_left_max[leaf],
-            parent_output=state.left_output[leaf])
+            parent_output=state.left_output[leaf],
+            has_categorical=self._has_cat)
         right_info = find_best_split(
             hist_right, state.right_sum_grad[leaf],
             state.right_sum_hess[leaf], rc, rtc, meta, params, mask_right,
             state.cand_right_min[leaf], state.cand_right_max[leaf],
-            parent_output=state.right_output[leaf])
+            parent_output=state.right_output[leaf],
+            has_categorical=self._has_cat)
 
         state = state._replace(leaf_of_row=leaf_of_row, hists=hists)
         state = _store_info(state, leaf, left_info, children_allowed)

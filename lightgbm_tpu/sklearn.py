@@ -15,8 +15,26 @@ from .basic import Booster, Dataset
 from .engine import train as _train
 from .utils import log
 
+# Inherit scikit-learn's bases when available so the estimators carry
+# proper tags/clone semantics and pass sklearn's conformance machinery
+# (reference: python-package/lightgbm/compat.py _LGBMModelBase — the
+# reference's estimators do exactly this behind a compat shim).
+try:  # pragma: no cover - import guard
+    from sklearn.base import (BaseEstimator as _LGBMModelBase,
+                              ClassifierMixin as _LGBMClassifierBase,
+                              RegressorMixin as _LGBMRegressorBase)
+except ImportError:  # pragma: no cover
+    class _LGBMModelBase:  # type: ignore
+        pass
 
-class LGBMModel:
+    class _LGBMClassifierBase:  # type: ignore
+        pass
+
+    class _LGBMRegressorBase:  # type: ignore
+        pass
+
+
+class LGBMModel(_LGBMModelBase):
     """Base estimator (reference: sklearn.py:364)."""
 
     def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
@@ -49,11 +67,24 @@ class LGBMModel:
         self.random_state = random_state
         self.n_jobs = n_jobs
         self.importance_type = importance_type
+        # sklearn contract: __init__ sets ONLY parameters; fitted state
+        # appears in fit() (check_no_attributes_set_in_init)
         self._other_params = dict(kwargs)
-        self._Booster: Optional[Booster] = None
-        self._evals_result: Dict = {}
-        self._best_iteration = -1
-        self.fitted_ = False
+
+    # underscore-prefixed state created lazily (not in __init__)
+    _Booster: Optional[Booster] = None
+    _evals_result: Optional[Dict] = None
+    _best_iteration = -1
+
+    def __sklearn_tags__(self):
+        """reference: sklearn.py LGBMModel._more_tags — NaN is a
+        first-class missing value and scipy sparse inputs are accepted
+        (binned via the sparse-until-binning path)."""
+        tags = super().__sklearn_tags__()
+        tags.input_tags.allow_nan = True
+        tags.input_tags.sparse = True
+        tags.non_deterministic = False
+        return tags
 
     # ------------------------------------------------------------------
     def get_params(self, deep: bool = True) -> Dict[str, Any]:
@@ -95,10 +126,18 @@ class LGBMModel:
         params.pop("importance_type", None)
         params.pop("n_estimators", None)
         params.pop("class_weight", None)
+        # fit-time overrides (e.g. multiclass promotion) live outside the
+        # constructor params so fit never mutates them
+        # (check_estimators_overwrite_params)
+        override = dict(getattr(self, "_fit_params_override", {}) or {})
         objective = params.pop("objective", None)
         if objective is None:
-            objective = self._default_objective()
+            objective = override.pop("objective", None) \
+                or self._default_objective()
+        else:
+            override.pop("objective", None)
         params["objective"] = objective
+        params.update(override)
         params["boosting"] = params.pop("boosting_type", "gbdt")
         if params.get("random_state") is None:
             params.pop("random_state", None)
@@ -109,11 +148,88 @@ class LGBMModel:
         params.setdefault("verbosity", -1)
         return params
 
+    @staticmethod
+    def _validate_fit_input(X, y, sample_weight=None):
+        """Input sanity errors sklearn's conformance machinery expects
+        (ValueError on empty / complex / NaN-y / mismatched data)."""
+        if y is None:
+            raise ValueError(
+                "This estimator requires y to be passed, but the "
+                "target y is None")
+        shape = getattr(X, "shape", None)
+        if shape is None:
+            X = np.asarray(X)
+            shape = X.shape
+        if len(shape) != 2:
+            raise ValueError(
+                "Expected 2D array, got array with shape %s instead"
+                % (tuple(shape),))
+        if shape[1] == 0:
+            raise ValueError(
+                "0 feature(s) (shape=(%d, 0)) while a minimum of 1 is "
+                "required." % shape[0])
+        if shape[0] == 0:
+            raise ValueError(
+                "0 sample(s) (shape=(0, %d)) while a minimum of 1 is "
+                "required." % shape[1])
+        if shape[0] == 1:
+            raise ValueError(
+                "Cannot fit a GBDT on 1 sample; at least 2 samples are "
+                "required")
+        if np.iscomplexobj(X) or np.iscomplexobj(np.asarray(y)):
+            raise ValueError("Complex data not supported")
+        y_arr = np.asarray(y)
+        if y_arr.dtype.kind not in ("U", "S", "O", "b"):
+            # numeric targets must be finite (string/object labels are
+            # the classifier's to encode)
+            y_num = y_arr.astype(np.float64)
+            if not np.all(np.isfinite(y_num)):
+                raise ValueError(
+                    "Input y contains NaN, infinity or a value too "
+                    "large")
+        if y_arr.shape[0] != shape[0]:
+            raise ValueError(
+                "Found input variables with inconsistent numbers of "
+                "samples: [%d, %d]" % (shape[0], y_arr.shape[0]))
+        if sample_weight is not None:
+            w = np.asarray(sample_weight)
+            if w.ndim != 1 or w.shape[0] != shape[0]:
+                raise ValueError(
+                    "sample_weight.shape == %s, expected (%d,)"
+                    % (w.shape, shape[0]))
+            if w.shape[0] > 0 and not np.any(w > 0):
+                raise ValueError(
+                    "No training samples: all sample_weight values are "
+                    "zero or negative")
+
+    @staticmethod
+    def _ensure_1d_y(y):
+        """Column-vector y → 1-D with sklearn's conversion warning
+        (check_supervised_y_2d contract)."""
+        if y is None:
+            return None  # the validator raises the requires-y error
+        y = np.asarray(y)
+        if y.ndim == 2 and y.shape[1] == 1:
+            import warnings
+            try:
+                from sklearn.exceptions import DataConversionWarning
+            except ImportError:  # pragma: no cover
+                DataConversionWarning = UserWarning
+            warnings.warn(
+                "A column-vector y was passed when a 1d array was "
+                "expected. Please change the shape of y to "
+                "(n_samples,), for example using ravel().",
+                DataConversionWarning)
+            y = y.ravel()
+        return y
+
     def fit(self, X, y, sample_weight=None, init_score=None, group=None,
             eval_set=None, eval_names=None, eval_sample_weight=None,
             eval_init_score=None, eval_group=None, eval_metric=None,
             feature_name="auto", categorical_feature="auto",
             callbacks=None) -> "LGBMModel":
+        y = self._ensure_1d_y(y)
+        self._validate_fit_input(X, y, sample_weight)
         params = self._process_params()
         if eval_metric is not None:
             params["metric"] = eval_metric
@@ -147,6 +263,7 @@ class LGBMModel:
             callbacks=callbacks)
         self._best_iteration = self._Booster.best_iteration
         self._n_features = train_set.num_feature()
+        self.n_features_in_ = self._n_features
         self.fitted_ = True
         return self
 
@@ -157,15 +274,36 @@ class LGBMModel:
                 pred_leaf: bool = False, pred_contrib: bool = False,
                 **kwargs):
         self._check_fitted()
+        self._check_n_features(X)
         return self._Booster.predict(
             X, raw_score=raw_score, start_iteration=start_iteration,
             num_iteration=num_iteration, pred_leaf=pred_leaf,
             pred_contrib=pred_contrib)
 
     def _check_fitted(self):
-        if not self.fitted_:
+        if not getattr(self, "fitted_", False):
+            try:
+                from sklearn.exceptions import NotFittedError
+            except ImportError:
+                NotFittedError = ValueError
+            raise NotFittedError(
+                "Estimator not fitted, call fit before exploiting the "
+                "model.")
+
+    def _check_n_features(self, X):
+        shape = getattr(X, "shape", None)
+        if shape is None:
+            shape = np.asarray(X).shape
+        if len(shape) == 1:
             raise ValueError(
-                "Estimator not fitted, call fit before exploiting the model.")
+                "Expected 2D array, got 1D array instead. Reshape your "
+                "data either using array.reshape(-1, 1) or "
+                "array.reshape(1, -1).")
+        n_in = getattr(self, "n_features_in_", None)
+        if len(shape) == 2 and n_in is not None and shape[1] != n_in:
+            raise ValueError(
+                "X has %d features, but %s is expecting %d features as "
+                "input" % (shape[1], type(self).__name__, n_in))
 
     @property
     def booster_(self) -> Booster:
@@ -198,28 +336,39 @@ class LGBMModel:
         return self._Booster.feature_name()
 
 
-class LGBMRegressor(LGBMModel):
+class LGBMRegressor(_LGBMRegressorBase, LGBMModel):
     """reference: sklearn.py:989."""
 
     def _default_objective(self) -> str:
         return "regression"
 
 
-class LGBMClassifier(LGBMModel):
+class LGBMClassifier(_LGBMClassifierBase, LGBMModel):
     """reference: sklearn.py:1035."""
 
     def _default_objective(self) -> str:
         return "binary"
 
     def fit(self, X, y, **kwargs):
+        y = self._ensure_1d_y(y)
+        self._validate_fit_input(X, y)
         y = np.asarray(y)
+        if y.dtype.kind == "f" and not np.all(y == np.floor(y)):
+            raise ValueError(
+                "Unknown label type: continuous. Classification targets "
+                "must be discrete")
+        if y.dtype.kind == "O":
+            # normalize mixed/object labels to strings so np.unique +
+            # searchsorted order deterministically
+            y = y.astype(str)
         self._classes = np.unique(y)
         self._n_classes = len(self._classes)
+        self._fit_params_override = {}
         if self._n_classes > 2:
             if not isinstance(self.objective, str) or \
                     self.objective not in ("multiclass", "multiclassova"):
-                self.objective = "multiclass"
-            self._other_params["num_class"] = self._n_classes
+                self._fit_params_override["objective"] = "multiclass"
+            self._fit_params_override["num_class"] = self._n_classes
         y_enc = np.searchsorted(self._classes, y).astype(np.float64)
         super().fit(X, y_enc, **kwargs)
         return self
@@ -248,6 +397,7 @@ class LGBMClassifier(LGBMModel):
                       pred_leaf: bool = False, pred_contrib: bool = False,
                       **kwargs):
         self._check_fitted()
+        self._check_n_features(X)
         result = self._Booster.predict(
             X, raw_score=raw_score, start_iteration=start_iteration,
             num_iteration=num_iteration, pred_leaf=pred_leaf,

@@ -329,7 +329,7 @@ def _partition_col(bins, f, meta, btab, bundled: bool):
 def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
                 valid, mask_left, mask_right, meta, params, btab, *,
                 S: int, B: int, Bg: int, bundled: bool, max_depth: int,
-                extra_trees: bool, children_allowed=None,
+                extra_trees: bool, has_cat: bool = True, children_allowed=None,
                 rand_seed=0, pen_left=None, pen_right=None) -> GrowState:
     """Apply one split (already chosen: ``rec`` at ``leaf``) and scan both
     children. Shared by the per-split and batched paths.
@@ -383,7 +383,8 @@ def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
         parent_output=rec.left_output,
         rand_bins=_maybe_rand_bins(extra_trees, rand_seed, 2 * new_leaf,
                                    meta, params),
-        gain_penalty=pen_left, leaf_depth=child_depth)
+        gain_penalty=pen_left, leaf_depth=child_depth,
+        has_categorical=has_cat)
     right_info = find_best_split(
         hist_right, rec.right_sum_grad, rec.right_sum_hess,
         rec.right_count, rec.right_total_count, meta, params,
@@ -392,7 +393,8 @@ def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
         parent_output=rec.right_output,
         rand_bins=_maybe_rand_bins(extra_trees, rand_seed,
                                    2 * new_leaf + 1, meta, params),
-        gain_penalty=pen_right, leaf_depth=child_depth)
+        gain_penalty=pen_right, leaf_depth=child_depth,
+        has_categorical=has_cat)
 
     state = state._replace(leaf_of_row=leaf_of_row, hists=hists,
                            leaf_depth=leaf_depth)
@@ -404,7 +406,7 @@ def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
 
 @functools.lru_cache(maxsize=None)
 def _root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
-                    extra_trees: bool):
+                    extra_trees: bool, has_cat: bool = True):
     def root(bins, gh, leaf_of_row0, feature_mask, children_allowed,
              rand_seed, meta, params, btab):
         F = meta.num_bin.shape[0]
@@ -419,7 +421,7 @@ def _root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
             feature_mask, parent_output=parent_out,
             rand_bins=_maybe_rand_bins(extra_trees, rand_seed, 0, meta,
                                        params),
-            leaf_depth=jnp.int32(0))
+            leaf_depth=jnp.int32(0), has_categorical=has_cat)
         state = make_root_state(gh, hist, leaf_of_row0, info, L, F, B,
                                 children_allowed)
         return state, _record_at(state, 0)
@@ -429,7 +431,7 @@ def _root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
 
 @functools.lru_cache(maxsize=None)
 def _step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
-                    extra_trees: bool):
+                    extra_trees: bool, has_cat: bool = True):
     """Per-split step (host chooses the leaf): used when per-node feature
     masks (interaction constraints / bynode sampling) force a host
     round-trip per split."""
@@ -440,7 +442,7 @@ def _step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
                             jnp.asarray(True), mask_left, mask_right,
                             meta, params, btab, S=S, B=B, Bg=Bg,
                             bundled=bundled, max_depth=0,
-                            extra_trees=extra_trees,
+                            extra_trees=extra_trees, has_cat=has_cat,
                             children_allowed=children_allowed,
                             rand_seed=rand_seed)
         best = jnp.argmax(state.gain).astype(jnp.int32)
@@ -463,7 +465,7 @@ def _cegb_penalty(params, count, used, coupled, unfetched, lazy):
 
 @functools.lru_cache(maxsize=None)
 def _cegb_root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
-                         has_lazy: bool):
+                         has_lazy: bool, has_cat: bool = True):
     def root(bins, gh, leaf_of_row0, feature_mask, children_allowed,
              used, fetched, coupled, lazy, meta, params, btab):
         F = meta.num_bin.shape[0]
@@ -480,7 +482,8 @@ def _cegb_root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
                             lazy)
         info = find_best_split(
             hist, sums[0], sums[1], sums[2], sums[3], meta, params,
-            feature_mask, parent_output=parent_out, gain_penalty=pen)
+            feature_mask, parent_output=parent_out, gain_penalty=pen,
+            has_categorical=has_cat)
         state = make_root_state(gh, hist, leaf_of_row0, info, L, F, B,
                                 children_allowed)
         return state, _record_at(state, 0)
@@ -490,7 +493,7 @@ def _cegb_root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
 
 @functools.lru_cache(maxsize=None)
 def _cegb_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
-                         has_lazy: bool):
+                         has_lazy: bool, has_cat: bool = True):
     """Per-split CEGB step: applies the pending split, updates the
     used-features vector and (lazy mode) the per-(row, feature) fetched
     matrix, and scans both children with penalized gains (reference:
@@ -536,7 +539,7 @@ def _cegb_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
                             jnp.asarray(True), feature_mask, feature_mask,
                             meta, params, btab, S=S, B=B, Bg=Bg,
                             bundled=bundled, max_depth=0,
-                            extra_trees=False,
+                            extra_trees=False, has_cat=has_cat,
                             children_allowed=children_allowed,
                             pen_left=pen_l, pen_right=pen_r)
         best = jnp.argmax(state.gain).astype(jnp.int32)
@@ -546,7 +549,8 @@ def _cegb_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
 
 
 @functools.lru_cache(maxsize=None)
-def _mono_step_fn_cached(S: int, B: int, Bg: int, bundled: bool):
+def _mono_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
+                         has_cat: bool = True):
     """Per-split step for monotone_constraints_method=intermediate: the
     children's output bounds come from the host tracker (sibling-output
     based, monotone_constraints.hpp:543) instead of the mid-point rule
@@ -563,7 +567,7 @@ def _mono_step_fn_cached(S: int, B: int, Bg: int, bundled: bool):
                             jnp.asarray(True), feature_mask, feature_mask,
                             meta, params, btab, S=S, B=B, Bg=Bg,
                             bundled=bundled, max_depth=0,
-                            extra_trees=False,
+                            extra_trees=False, has_cat=has_cat,
                             children_allowed=children_allowed)
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), state.gain
@@ -572,7 +576,7 @@ def _mono_step_fn_cached(S: int, B: int, Bg: int, bundled: bool):
 
 
 @functools.lru_cache(maxsize=None)
-def _rescan_fn_cached(B: int):
+def _rescan_fn_cached(B: int, has_cat: bool = True):
     """Recompute one leaf's best-split candidate from its stored
     histogram under tightened output bounds (reference:
     SerialTreeLearner::RecomputeBestSplitForLeaf,
@@ -585,7 +589,8 @@ def _rescan_fn_cached(B: int):
         info = find_best_split(hist, sg, sh, c, tc, meta, params,
                                feature_mask, vmin, vmax,
                                parent_output=parent_out,
-                               leaf_depth=depth)
+                               leaf_depth=depth,
+                               has_categorical=has_cat)
         state = _store_info(state, leaf, info, allowed)
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), state.gain
@@ -595,7 +600,7 @@ def _rescan_fn_cached(B: int):
 
 @functools.lru_cache(maxsize=None)
 def _forced_fn_cached(S: int, B: int, Bg: int, bundled: bool,
-                      extra_trees: bool):
+                      extra_trees: bool, has_cat: bool = True):
     """Forced split of a given (feature, threshold-bin) on a leaf
     (reference: SerialTreeLearner::ForceSplits,
     serial_tree_learner.cpp:451): the split record is built from the
@@ -635,6 +640,7 @@ def _forced_fn_cached(S: int, B: int, Bg: int, bundled: bool,
                             feature_mask, feature_mask, meta, params,
                             btab, S=S, B=B, Bg=Bg, bundled=bundled,
                             max_depth=0, extra_trees=extra_trees,
+                            has_cat=has_cat,
                             children_allowed=children_allowed,
                             rand_seed=rand_seed)
         return state, rec, ok
@@ -644,7 +650,8 @@ def _forced_fn_cached(S: int, B: int, Bg: int, bundled: bool,
 
 @functools.lru_cache(maxsize=None)
 def _batch_fn_cached(S: int, kb: int, B: int, Bg: int, bundled: bool,
-                     max_depth: int, extra_trees: bool):
+                     max_depth: int, extra_trees: bool,
+                     has_cat: bool = True):
     """Batched split steps: one dispatch runs kb splits, the device
     picking the best leaf each step (the argmax the reference does on host
     at serial_tree_learner.cpp:194). Records of the applied splits are
@@ -664,7 +671,7 @@ def _batch_fn_cached(S: int, kb: int, B: int, Bg: int, bundled: bool,
                                 feature_mask, feature_mask, meta, params,
                                 btab, S=S, B=B, Bg=Bg, bundled=bundled,
                                 max_depth=max_depth,
-                                extra_trees=extra_trees,
+                                extra_trees=extra_trees, has_cat=has_cat,
                                 rand_seed=rand_seed)
             return state, recs
 
@@ -725,8 +732,13 @@ class SerialTreeLearner:
         self._extra_trees = bool(config.extra_trees)
         self._extra_seed = int(config.extra_seed)
         self._tree_idx = 0
+        # STATIC: all-numerical datasets compile out the categorical
+        # scans entirely (two argsorts + a sequential 256-step lax.scan
+        # per leaf scan)
+        self._has_cat = bool(np.asarray(self.meta.is_categorical).any())
         self._root_fn = _root_fn_cached(self.L, self.B, self.Bg,
-                                        self._bundled, self._extra_trees)
+                                        self._bundled, self._extra_trees,
+                                        self._has_cat)
         self._forced = self._load_forced_splits(config)
         self._init_cegb(config)
         self._init_monotone(config)
@@ -893,12 +905,13 @@ class SerialTreeLearner:
 
     def _step_fn(self, S: int):
         return _step_fn_cached(S, self.B, self.Bg, self._bundled,
-                               self._extra_trees)
+                               self._extra_trees, self._has_cat)
 
     def _batch_fn(self, S: int):
         kb = self._batch_k(S)
         return (_batch_fn_cached(S, kb, self.B, self.Bg, self._bundled,
-                                 self.max_depth, self._extra_trees), kb)
+                                 self.max_depth, self._extra_trees,
+                                 self._has_cat), kb)
 
     def _batch_k(self, S: int) -> int:
         """Steps per dispatch: aim for ~4R gathered rows per batch so early
@@ -955,7 +968,7 @@ class SerialTreeLearner:
             M = max(leaf_total.values())
             S = self._bucket(M / 2)
             fn = _forced_fn_cached(S, self.B, self.Bg, self._bundled,
-                                   self._extra_trees)
+                                   self._extra_trees, self._has_cat)
             allowed = self._splittable(int(tree.leaf_depth[leaf]) + 1)
             state, rec, ok = fn(self.bins, state, jnp.int32(leaf),
                                 jnp.int32(next_leaf), jnp.int32(inner),
@@ -1070,7 +1083,8 @@ class SerialTreeLearner:
             log.warning("CEGB runs without forced splits / per-node "
                         "feature masks")
         root = _cegb_root_fn_cached(self.L, self.B, self.Bg,
-                                    self._bundled, self._cegb_has_lazy)
+                                    self._bundled, self._cegb_has_lazy,
+                                    self._has_cat)
         state, rec = root(self.bins, gh, self._leaf_of_row0, feature_mask,
                           self._splittable(0), self._cegb_used,
                           self._cegb_fetched, self._cegb_coupled,
@@ -1087,7 +1101,8 @@ class SerialTreeLearner:
                           float(pending.right_total_count))
             S = self._bucket(smaller)
             fn = _cegb_step_fn_cached(S, self.B, self.Bg, self._bundled,
-                                      self._cegb_has_lazy)
+                                      self._cegb_has_lazy,
+                                      self._has_cat)
             state, rec, self._cegb_used, self._cegb_fetched = fn(
                 self.bins, state, jnp.int32(leaf), jnp.int32(k),
                 jnp.asarray(children_allowed), feature_mask,
@@ -1110,14 +1125,18 @@ class SerialTreeLearner:
         if self._constraint_groups is not None:
             log.warning("interaction constraints are ignored under "
                         "monotone_constraints_method=intermediate")
-        state, rec = self._root_fn(self.bins, gh, self._leaf_of_row0,
-                                   feature_mask, self._splittable(0),
-                                   rand_seed, self.meta, self.params,
-                                   self._btab)
+        # extra_trees is ignored on this path — the root scan must be
+        # greedy too, not just the step scans
+        root_fn = _root_fn_cached(self.L, self.B, self.Bg, self._bundled,
+                                  False, self._has_cat)
+        state, rec = root_fn(self.bins, gh, self._leaf_of_row0,
+                             feature_mask, self._splittable(0),
+                             rand_seed, self.meta, self.params,
+                             self._btab)
         pending = jax.device_get(rec)
         gains_h = None
         leaf_sums: dict = {}
-        rescan = _rescan_fn_cached(self.B)
+        rescan = _rescan_fn_cached(self.B, self._has_cat)
         for k in range(1, self.L):
             if not record_is_valid(pending):
                 break
@@ -1152,7 +1171,8 @@ class SerialTreeLearner:
             smaller = min(float(pending.left_total_count),
                           float(pending.right_total_count))
             S = self._bucket(smaller)
-            fn = _mono_step_fn_cached(S, self.B, self.Bg, self._bundled)
+            fn = _mono_step_fn_cached(S, self.B, self.Bg,
+                                      self._bundled, self._has_cat)
             applied_tbin = int(pending.threshold_bin)
             applied_numerical = not bool(pending.is_categorical)
             state, rec, gains_d = fn(

@@ -183,3 +183,41 @@ class TestLinearTreeExport:
         got = _run_compiled(lib, "Predict", X, 1)[:, 0]
         want = bst.predict(X)
         np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-10)
+
+
+class TestModelTextSectionOrder:
+    def test_sections_in_reference_order(self, mixed_booster, tmp_path):
+        """Section ordering must match GBDT::SaveModelToString
+        (gbdt_model_text.cpp:311-408) so the reference's parser loads our
+        files: header keys in order, tree_sizes, Tree=i blocks, 'end of
+        trees', feature_importances, parameters block."""
+        bst, _, _ = mixed_booster
+        s = bst.model_to_string()
+        order = ["tree\n", "version=v3", "num_class=", 
+                 "num_tree_per_iteration=", "label_index=",
+                 "max_feature_idx=", "objective=", "feature_names=",
+                 "feature_infos=", "tree_sizes=", "Tree=0",
+                 "end of trees", "feature_importances:", "parameters:",
+                 "end of parameters"]
+        pos = -1
+        for key in order:
+            nxt = s.find(key, pos + 1)
+            assert nxt > pos, "section %r out of order or missing" % key
+            pos = nxt
+
+    def test_tree_sizes_match_blocks(self, mixed_booster):
+        """tree_sizes entries are the byte length of each Tree block —
+        the reference uses them to parallel-parse (gbdt_model_text.cpp)."""
+        bst, _, _ = mixed_booster
+        s = bst.model_to_string()
+        sizes_line = [ln for ln in s.splitlines()
+                      if ln.startswith("tree_sizes=")][0]
+        sizes = [int(v) for v in sizes_line.split("=")[1].split()]
+        body = s.split("tree_sizes=")[1].split("\n", 1)[1]
+        # skip the blank line after the header block
+        body = body.lstrip("\n")
+        for i, size in enumerate(sizes):
+            block = body[:size]
+            assert block.startswith("Tree=%d\n" % i)
+            body = body[size:]
+        assert body.startswith("end of trees")

@@ -1,0 +1,92 @@
+"""Streaming/push dataset API tests — analogue of the reference's
+tests/cpp_tests/test_stream.cpp + test_chunked_array.cpp."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.streaming import ChunkedBuffer, StreamingDataset
+
+
+class TestChunkedBuffer:
+    def test_append_and_coalesce(self):
+        cb = ChunkedBuffer(3, chunk_rows=10)
+        rng = np.random.RandomState(0)
+        parts = [rng.randn(n, 3) for n in (4, 10, 17, 1)]
+        for part in parts:
+            cb.append_rows(part)
+        want = np.concatenate(parts)
+        assert len(cb) == want.shape[0]
+        np.testing.assert_array_equal(cb.coalesce(), want)
+
+    def test_empty(self):
+        cb = ChunkedBuffer(2)
+        assert len(cb) == 0
+        assert cb.coalesce().shape == (0, 2)
+
+    def test_exact_chunk_boundary(self):
+        cb = ChunkedBuffer(1, chunk_rows=8)
+        cb.append_rows(np.arange(16, dtype=float).reshape(16, 1))
+        assert len(cb) == 16
+        np.testing.assert_array_equal(cb.coalesce()[:, 0],
+                                      np.arange(16))
+
+
+class TestStreamingDataset:
+    def test_streamed_equals_batch(self):
+        """Pushing in chunks must produce the identical model to a
+        one-shot Dataset (reference: test_stream.cpp streamed-vs-batch
+        dataset comparison)."""
+        rng = np.random.RandomState(3)
+        X = rng.randn(1200, 6)
+        y = (X[:, 0] - X[:, 1] > 0).astype(float)
+        params = {"objective": "binary", "num_leaves": 15,
+                  "verbosity": -1, "bin_construct_sample_cnt": 1200}
+
+        sd = StreamingDataset(num_features=6, params=params,
+                              chunk_rows=256)
+        for lo in range(0, 1200, 300):
+            sd.push_rows(X[lo:lo + 300], label=y[lo:lo + 300])
+        assert sd.num_pushed == 1200
+        ds_stream = sd.finalize()
+
+        bst_s = lgb.train(params, lgb.Dataset(ds_stream.X_raw, label=y)
+                          if hasattr(ds_stream, "X_raw") else
+                          lgb.Dataset(X, label=y), num_boost_round=5)
+        bst_b = lgb.train(params, lgb.Dataset(X, label=y),
+                          num_boost_round=5)
+        np.testing.assert_allclose(bst_s.predict(X), bst_b.predict(X),
+                                   rtol=1e-12)
+        # the streamed BinnedDataset itself matches the batch one
+        from lightgbm_tpu.io.dataset import BinnedDataset
+        from lightgbm_tpu.config import Config
+        ds_batch = BinnedDataset.from_matrix(
+            X, Config.from_params(params), label=y)
+        np.testing.assert_array_equal(np.asarray(ds_stream.bins),
+                                      np.asarray(ds_batch.bins))
+
+    def test_metadata_streams(self):
+        rng = np.random.RandomState(4)
+        X = rng.randn(400, 3)
+        y = rng.rand(400)
+        w = rng.rand(400) + 0.5
+        sd = StreamingDataset(num_features=3, params={"verbosity": -1},
+                              has_weight=True)
+        sd.push_rows(X[:250], label=y[:250], weight=w[:250])
+        sd.push_rows(X[250:], label=y[250:], weight=w[250:])
+        ds = sd.finalize()
+        np.testing.assert_allclose(ds.metadata.label, y)
+        np.testing.assert_allclose(ds.metadata.weights, w)
+
+    def test_push_after_finalize_fails(self):
+        from lightgbm_tpu.utils.log import LightGBMError
+        sd = StreamingDataset(num_features=2, params={"verbosity": -1})
+        sd.push_rows(np.zeros((50, 2)), label=np.zeros(50))
+        sd.finalize()
+        with pytest.raises(LightGBMError):
+            sd.push_rows(np.zeros((1, 2)))
+
+    def test_column_mismatch_fails(self):
+        from lightgbm_tpu.utils.log import LightGBMError
+        sd = StreamingDataset(num_features=4, params={"verbosity": -1})
+        with pytest.raises(LightGBMError):
+            sd.push_rows(np.zeros((5, 3)))
