@@ -53,8 +53,17 @@ def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
                      max_bin: int, total_cnt: int,
                      min_data_in_bin: int) -> List[float]:
     """Greedy equal-count bin boundary search
-    (reference: src/io/bin.cpp:78-152)."""
+    (reference: src/io/bin.cpp:78-152). Dispatches to the native C++
+    implementation when available — this Python loop over distinct
+    values dominates BinnedDataset construction otherwise (~80 ms per
+    continuous feature at a 200k sample)."""
     assert max_bin > 0
+    if len(distinct_values) > 512:  # native pays off past trivial sizes
+        from ..native import greedy_find_bin
+        bounds = greedy_find_bin(distinct_values, counts, max_bin,
+                                 total_cnt, min_data_in_bin)
+        if bounds is not None:
+            return [float(v) for v in bounds]
     num_distinct = len(distinct_values)
     bin_upper_bound: List[float] = []
     if num_distinct <= max_bin:

@@ -143,17 +143,23 @@ class StreamingDataset:
         n = X.shape[0]
         if n == 0:
             log.fatal("no rows pushed before finalize()")
-        label = self._label.coalesce()[:, 0] if len(self._label) else None
-        if label is not None and len(label) != n:
-            log.fatal("pushed %d labels for %d rows" % (len(label), n))
-        weight = (self._weight.coalesce()[:, 0]
-                  if self._weight is not None and len(self._weight)
-                  else None)
-        init_score = (self._init_score.coalesce()[:, 0]
-                      if self._init_score is not None
-                      and len(self._init_score) else None)
+        def aligned(buf, what):
+            if buf is None or not len(buf):
+                return None
+            vals = buf.coalesce()[:, 0]
+            if len(vals) != n:
+                log.fatal("pushed %d %s values for %d rows"
+                          % (len(vals), what, n))
+            return vals
+
+        label = aligned(self._label, "label")
+        weight = aligned(self._weight, "weight")
+        init_score = aligned(self._init_score, "init_score")
         group = (np.asarray(self._group, dtype=np.int32)
                  if self._group else None)
+        if group is not None and int(group.sum()) != n:
+            log.fatal("pushed query sizes sum to %d for %d rows"
+                      % (int(group.sum()), n))
         return BinnedDataset.from_matrix(
             X, self.config, label=label, weights=weight,
             init_score=init_score, group=group, reference=reference,

@@ -56,6 +56,12 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_long)]
             lib.FreeBuffer.restype = None
             lib.FreeBuffer.argtypes = [ctypes.c_void_p]
+            lib.GreedyFindBin.restype = ctypes.c_int
+            lib.GreedyFindBin.argtypes = [
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_long, ctypes.c_int, ctypes.c_double,
+                ctypes.c_int, ctypes.POINTER(ctypes.c_double)]
             _LIB = lib
         except Exception:
             _LIB_FAILED = True
@@ -88,6 +94,28 @@ def parse_dense(path: str, delim: str, skip_rows: int
         return arr.reshape(rows.value, cols.value)
     finally:
         lib.FreeBuffer(out)
+
+
+def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                    max_bin: int, total_cnt: int,
+                    min_data_in_bin: int) -> Optional[np.ndarray]:
+    """Native GreedyFindBin (reference: src/io/bin.cpp:78) — returns the
+    bin upper bounds, or None when the native library is unavailable
+    (caller falls back to the Python implementation)."""
+    lib = _build_and_load()
+    if lib is None:
+        return None
+    dv = np.ascontiguousarray(distinct_values, dtype=np.float64)
+    cn = np.ascontiguousarray(counts, dtype=np.float64)
+    out = np.empty(max(max_bin, 1) + 1, dtype=np.float64)
+    n = lib.GreedyFindBin(
+        dv.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        cn.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_long(len(dv)), ctypes.c_int(int(max_bin)),
+        ctypes.c_double(float(total_cnt)),
+        ctypes.c_int(int(min_data_in_bin)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    return out[:n].copy()
 
 
 def parse_libsvm(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:

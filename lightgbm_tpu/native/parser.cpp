@@ -24,6 +24,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <cmath>
+#include <limits>
 #include <string>
 #include <vector>
 
@@ -197,6 +198,92 @@ int ParseLibSVM(const char* path, double** out, double** labels,
   *n_rows = rows;
   *n_cols = cols;
   return 0;
+}
+
+// Greedy equal-count bin boundary search over (distinct value, count)
+// pairs — the hot loop of BinMapper construction (reference:
+// GreedyFindBin, src/io/bin.cpp:78-152). Must match the Python
+// implementation in io/binning.py bit-for-bit: same double arithmetic,
+// same nextafter-based dedup of boundaries.
+//
+// out must have room for max_bin + 1 doubles; returns the number of
+// bounds written (the last one is +inf).
+int GreedyFindBin(const double* distinct_values, const double* counts,
+                  long num_distinct, int max_bin, double total_cnt,
+                  int min_data_in_bin, double* out) {
+  const double kInf = std::numeric_limits<double>::infinity();
+  int n_out = 0;
+  auto push_bound = [&](double val) {
+    if (n_out == 0 || val > std::nextafter(out[n_out - 1], kInf)) {
+      out[n_out++] = val;
+    }
+  };
+  if (num_distinct <= max_bin) {
+    double cur_cnt_inbin = 0;
+    for (long i = 0; i < num_distinct - 1; ++i) {
+      cur_cnt_inbin += counts[i];
+      if (cur_cnt_inbin >= min_data_in_bin) {
+        double mid = (distinct_values[i] + distinct_values[i + 1]) / 2.0;
+        double val = std::nextafter(mid, kInf);
+        int before = n_out;
+        push_bound(val);
+        if (n_out > before) cur_cnt_inbin = 0;
+      }
+    }
+    out[n_out++] = kInf;
+    return n_out;
+  }
+
+  if (min_data_in_bin > 0) {
+    long cap = static_cast<long>(total_cnt) / min_data_in_bin;
+    if (cap < max_bin) max_bin = static_cast<int>(cap);
+    if (max_bin < 1) max_bin = 1;
+  }
+  double mean_bin_size = total_cnt / max_bin;
+
+  std::vector<char> is_big(num_distinct);
+  long n_big = 0;
+  double big_cnt = 0;
+  for (long i = 0; i < num_distinct; ++i) {
+    is_big[i] = counts[i] >= mean_bin_size;
+    if (is_big[i]) { ++n_big; big_cnt += counts[i]; }
+  }
+  long rest_bin_cnt = max_bin - n_big;
+  double rest_sample_cnt = total_cnt - big_cnt;
+  mean_bin_size = rest_sample_cnt /
+      (rest_bin_cnt > 1 ? rest_bin_cnt : 1);
+
+  std::vector<double> upper_bounds(max_bin, kInf);
+  std::vector<double> lower_bounds(max_bin, kInf);
+  int bin_cnt = 0;
+  lower_bounds[0] = distinct_values[0];
+  double cur_cnt_inbin = 0;
+  for (long i = 0; i < num_distinct - 1; ++i) {
+    if (!is_big[i]) rest_sample_cnt -= counts[i];
+    cur_cnt_inbin += counts[i];
+    double half = mean_bin_size * 0.5;
+    if (half < 1.0) half = 1.0;
+    if (is_big[i] || cur_cnt_inbin >= mean_bin_size ||
+        (is_big[i + 1] && cur_cnt_inbin >= half)) {
+      upper_bounds[bin_cnt] = distinct_values[i];
+      ++bin_cnt;
+      lower_bounds[bin_cnt] = distinct_values[i + 1];
+      if (bin_cnt >= max_bin - 1) break;
+      cur_cnt_inbin = 0;
+      if (!is_big[i]) {
+        --rest_bin_cnt;
+        mean_bin_size = rest_sample_cnt /
+            (rest_bin_cnt > 1 ? rest_bin_cnt : 1);
+      }
+    }
+  }
+  ++bin_cnt;
+  for (int i = 0; i < bin_cnt - 1; ++i) {
+    double mid = (upper_bounds[i] + lower_bounds[i + 1]) / 2.0;
+    push_bound(std::nextafter(mid, kInf));
+  }
+  out[n_out++] = kInf;
+  return n_out;
 }
 
 }  // extern "C"

@@ -131,11 +131,14 @@ class LGBMModel(_LGBMModelBase):
         # (check_estimators_overwrite_params)
         override = dict(getattr(self, "_fit_params_override", {}) or {})
         objective = params.pop("objective", None)
-        if objective is None:
-            objective = override.pop("objective", None) \
-                or self._default_objective()
-        else:
-            override.pop("objective", None)
+        ov_obj = override.pop("objective", None)
+        if ov_obj is not None:
+            # fit-time promotion (e.g. >2 classes -> multiclass) WINS
+            # over the constructor objective, matching the pre-override
+            # behavior of forcing multiclass
+            objective = ov_obj
+        elif objective is None:
+            objective = self._default_objective()
         params["objective"] = objective
         params.update(override)
         params["boosting"] = params.pop("boosting_type", "gbdt")
@@ -358,9 +361,11 @@ class LGBMClassifier(_LGBMClassifierBase, LGBMModel):
                 "Unknown label type: continuous. Classification targets "
                 "must be discrete")
         if y.dtype.kind == "O":
-            # normalize mixed/object labels to strings so np.unique +
-            # searchsorted order deterministically
-            y = y.astype(str)
+            # normalize MIXED-type object labels to strings so np.unique
+            # + searchsorted order deterministically; homogeneous object
+            # arrays (e.g. pandas int columns) keep their label type
+            if len({type(v) for v in y}) > 1:
+                y = y.astype(str)
         self._classes = np.unique(y)
         self._n_classes = len(self._classes)
         self._fit_params_override = {}

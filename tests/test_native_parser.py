@@ -88,3 +88,45 @@ def test_parse_libsvm_truncated_pair(tmp_path):
     np.testing.assert_allclose(y, [1, 0.5])
     assert X[0].sum() == 0.0  # the dangling "3:" contributed nothing
     np.testing.assert_allclose(X[1, 1], 2.0)
+
+
+def test_greedy_find_bin_matches_python():
+    """Native GreedyFindBin must match the Python implementation
+    bit-for-bit over assorted distributions."""
+    from lightgbm_tpu.native import greedy_find_bin
+    import lightgbm_tpu.io.binning as binning
+    rng = np.random.RandomState(0)
+    cases = []
+    for n, kind in ((3000, "normal"), (600, "heavy"), (10000, "uniform"),
+                    (40, "tiny"), (255, "exact")):
+        if kind == "normal":
+            v = np.sort(np.unique(rng.randn(n)))
+        elif kind == "heavy":
+            v = np.sort(np.unique(np.round(rng.randn(n) * 3)))
+        elif kind == "uniform":
+            v = np.sort(np.unique(rng.rand(n)))
+        else:
+            v = np.sort(np.unique(rng.randn(n)))
+        c = rng.randint(1, 50, len(v)).astype(np.float64)
+        cases.append((v, c))
+    for v, c in cases:
+        for max_bin, mdib in ((255, 3), (63, 1), (16, 10)):
+            total = int(c.sum())
+            native = greedy_find_bin(v, c, max_bin, total, mdib)
+            assert native is not None
+            # force the pure-Python path by calling below the dispatch
+            # threshold logic: replicate its body via a tiny shim
+            py = binning._greedy_find_bin.__wrapped__(v, c, max_bin, total, mdib) \
+                if hasattr(binning._greedy_find_bin, "__wrapped__") else None
+            if py is None:
+                # no wrapper: temporarily disable native
+                import lightgbm_tpu.native as nat
+                orig = nat.greedy_find_bin
+                nat.greedy_find_bin = lambda *a, **k: None
+                try:
+                    py = binning._greedy_find_bin(v, c, max_bin, total,
+                                                  mdib)
+                finally:
+                    nat.greedy_find_bin = orig
+            np.testing.assert_array_equal(np.asarray(native),
+                                          np.asarray(py))

@@ -51,3 +51,27 @@ def test_unfitted_raises_notfitted():
     from sklearn.exceptions import NotFittedError
     with pytest.raises(NotFittedError):
         LGBMRegressor().predict(np.zeros((3, 2)))
+
+
+def test_multiclass_promotion_overrides_explicit_objective():
+    """>2 classes must promote to multiclass even when the constructor
+    says binary (reference: sklearn.py forces multiclass), and the
+    constructor param must NOT be mutated by fit."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(600, 4)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    clf = LGBMClassifier(objective="binary", n_estimators=5,
+                         num_leaves=7, verbosity=-1)
+    clf.fit(X, y)
+    assert clf.objective == "binary"  # param untouched
+    assert set(np.unique(clf.predict(X))) == {0, 1, 2}
+    assert clf.predict_proba(X).shape == (600, 3)
+
+
+def test_object_dtype_int_labels_keep_type():
+    rng = np.random.RandomState(1)
+    X = rng.randn(300, 3)
+    y = (X[:, 0] > 0).astype(int).astype(object)
+    clf = LGBMClassifier(n_estimators=4, num_leaves=7, verbosity=-1)
+    clf.fit(X, y)
+    assert (clf.predict(X) == np.asarray(y)).mean() > 0.9

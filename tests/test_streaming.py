@@ -49,9 +49,11 @@ class TestStreamingDataset:
         assert sd.num_pushed == 1200
         ds_stream = sd.finalize()
 
-        bst_s = lgb.train(params, lgb.Dataset(ds_stream.X_raw, label=y)
-                          if hasattr(ds_stream, "X_raw") else
-                          lgb.Dataset(X, label=y), num_boost_round=5)
+        # train directly ON the streamed BinnedDataset by pre-seeding a
+        # Dataset wrapper's handle with it
+        wrapper = lgb.Dataset(X, label=y, params=params)
+        wrapper._handle = ds_stream
+        bst_s = lgb.train(params, wrapper, num_boost_round=5)
         bst_b = lgb.train(params, lgb.Dataset(X, label=y),
                           num_boost_round=5)
         np.testing.assert_allclose(bst_s.predict(X), bst_b.predict(X),
