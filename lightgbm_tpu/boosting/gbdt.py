@@ -132,6 +132,11 @@ class GBDT:
 
         if config.objective in ("multiclass", "multiclassova"):
             self.num_class = int(config.num_class)
+        elif config.objective in ("custom", "none"):
+            # custom fobj drives num_class trees per iteration
+            # (reference: gbdt.cpp num_tree_per_iteration_ = num_class_
+            # regardless of objective; grads arrive class-major)
+            self.num_class = max(int(config.num_class), 1)
         else:
             self.num_class = 1
 

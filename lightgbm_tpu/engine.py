@@ -41,7 +41,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
                                   "num_boost_round", "n_estimators")):
         num_boost_round = cfg.num_iterations
 
-    train_set.params = dict(params, **(train_set.params or {}))
+    merged = dict(params, **(train_set.params or {}))
+    if callable(merged.get("objective")):
+        # a callable can ride in via the Dataset's own params (e.g. the
+        # sklearn wrapper); Config only understands strings
+        merged["objective"] = "none"
+    train_set.params = merged
     train_set.construct()
 
     booster = Booster(params=params, train_set=train_set)
@@ -239,7 +244,12 @@ def cv(params: Dict[str, Any], train_set: Dataset,
     cfg = Config.from_params(params)
     if cfg.objective not in ("binary", "multiclass", "multiclassova"):
         stratified = False
-    train_set.params = dict(params, **(train_set.params or {}))
+    merged = dict(params, **(train_set.params or {}))
+    if callable(merged.get("objective")):
+        # a callable can ride in via the Dataset's own params (e.g. the
+        # sklearn wrapper); Config only understands strings
+        merged["objective"] = "none"
+    train_set.params = merged
     train_set.construct()
     folds_idx = _make_n_folds(train_set, folds, nfold, params,
                               cfg.seed, stratified, shuffle)

@@ -51,81 +51,106 @@ def _allreduce_sum(vals: Sequence[float]) -> np.ndarray:
 def train(params: Dict, local_X: np.ndarray, local_y: np.ndarray,
           num_boost_round: int = 100,
           local_weight: Optional[np.ndarray] = None,
+          local_group: Optional[np.ndarray] = None,
           mesh=None) -> Booster:
     """Distributed GBDT boosting over per-process row shards. Returns a
     Booster (identical on every process). Gradient/hessian computation
     and score updates stay local to each process (reference: every rank
     runs the full GBDT driver in 3.1 with only the tree learner
-    synchronized, src/boosting/gbdt.cpp + parallel learners)."""
+    synchronized, src/boosting/gbdt.cpp + parallel learners).
+
+    Multiclass trains num_class trees per iteration over the shared
+    partition. Ranking objectives require query-aligned shards
+    (``local_group`` per process), like the reference's pre-partitioned
+    distributed data (config.h pre_partition)."""
     config = Config.from_params(params)
-    if config.num_class > 1 or str(config.objective).startswith(
-            ("lambdarank", "rank_xendcg", "multiclass")):
-        log.fatal("distributed train currently supports single-model "
-                  "objectives (binary / regression family)")
     local_X = np.asarray(local_X, dtype=np.float64)
     local_y = np.asarray(local_y, dtype=np.float64)
     n_local = local_X.shape[0]
 
     ds = distributed_binned_dataset(local_X, config, label=local_y,
-                                    weights=local_weight)
+                                    weights=local_weight,
+                                    group=local_group)
     mesh = mesh if mesh is not None else global_mesh()
     learner = DistributedDataParallelLearner(config, ds, mesh)
 
     objective = create_objective(config.objective, config)
     objective.init(ds.metadata, n_local)
 
+    K = max(int(objective.num_tree_per_iteration), 1)
+
     # boost_from_average over the GLOBAL label sums (reference:
     # BoostFromScore uses the full data; each rank only has a shard — the
     # init score must be identical everywhere or the shared trees would
     # sit on inconsistent base scores)
-    init_score = 0.0
+    init_scores = [0.0] * K
     if config.boost_from_average and objective is not None:
         w = (np.ones(n_local) if local_weight is None
              else np.asarray(local_weight, dtype=np.float64))
-        tot = _allreduce_sum([float((local_y * w).sum()), float(w.sum())])
-        gmean = tot[0] / max(tot[1], 1e-300)
         name = objective.name
         eps = 1e-15
-        if name == "binary":
-            p = min(max(gmean, eps), 1.0 - eps)
-            init_score = float(np.log(p / (1.0 - p))
-                               / float(config.sigmoid))
-        elif name in ("regression", "huber", "fair"):
-            init_score = float(gmean)
-        elif name in ("poisson", "gamma", "tweedie"):
-            init_score = float(np.log(max(gmean, eps)))
+        if name == "multiclassova":
+            sums = [float((w * (local_y.astype(np.int32) == k)).sum())
+                    for k in range(K)] + [float(w.sum())]
+            tot = _allreduce_sum(sums)
+            for k in range(K):
+                p = min(max(tot[k] / max(tot[-1], 1e-300), eps),
+                        1.0 - eps)
+                init_scores[k] = float(np.log(p / (1.0 - p))
+                                       / float(config.sigmoid))
+        elif name == "multiclass":
+            pass  # softmax trains from zero scores (matches GBDT)
         else:
-            # percentile-based objectives (l1/quantile/mape) are not
-            # sum-decomposable; use the local shard's value everywhere
-            # via a rank-0 broadcast-free approximation
-            init_score = float(objective.boost_from_score(0))
-            log.warning("%s boost_from_average uses per-shard "
-                        "percentiles; init score is approximate"
-                        % name)
+            tot = _allreduce_sum([float((local_y * w).sum()),
+                                  float(w.sum())])
+            gmean = tot[0] / max(tot[1], 1e-300)
+            if name == "binary":
+                p = min(max(gmean, eps), 1.0 - eps)
+                init_scores[0] = float(np.log(p / (1.0 - p))
+                                       / float(config.sigmoid))
+            elif name in ("regression", "huber", "fair"):
+                init_scores[0] = float(gmean)
+            elif name in ("poisson", "gamma", "tweedie"):
+                init_scores[0] = float(np.log(max(gmean, eps)))
+            elif name in ("lambdarank", "rank_xendcg"):
+                pass  # ranking trains from zero scores
+            else:
+                # percentile-based objectives (l1/quantile/mape) are not
+                # sum-decomposable; per-shard approximation
+                init_scores[0] = float(objective.boost_from_score(0))
+                log.warning("%s boost_from_average uses per-shard "
+                            "percentiles; init score is approximate"
+                            % name)
 
-    score = np.full(n_local, init_score, dtype=np.float64)
+    score = np.tile(np.asarray(init_scores, dtype=np.float64),
+                    (n_local, 1))                       # [n, K]
     lr = float(config.learning_rate)
     trees = []
     for it in range(num_boost_round):
-        grad, hess = objective.get_gradients(
-            jnp.asarray(score, dtype=jnp.float32))
-        tree, part = learner.train(np.asarray(grad, np.float32),
-                                   np.asarray(hess, np.float32))
-        tree.apply_shrinkage(lr)
-        local_leaf = learner.local_leaf_assignment(part)
-        score += tree.leaf_value[local_leaf]
-        if it == 0 and abs(init_score) > 1e-35:
-            # fold the init score into the first tree so saved models
-            # predict standalone (reference: gbdt.cpp new_tree->AddBias)
-            tree.add_bias(init_score)
-        trees.append(tree)
+        sc = jnp.asarray(score[:, 0] if K == 1 else score,
+                         dtype=jnp.float32)
+        grad, hess = objective.get_gradients(sc)
+        g = np.asarray(grad, np.float32).reshape(n_local, K)
+        h = np.asarray(hess, np.float32).reshape(n_local, K)
+        for k in range(K):
+            tree, part = learner.train(g[:, k], h[:, k])
+            tree.apply_shrinkage(lr)
+            local_leaf = learner.local_leaf_assignment(part)
+            score[:, k] += tree.leaf_value[local_leaf]
+            if it == 0 and abs(init_scores[k]) > 1e-35:
+                # fold the init score into the first tree so saved
+                # models predict standalone (reference: gbdt.cpp
+                # new_tree->AddBias)
+                tree.add_bias(init_scores[k])
+            trees.append(tree)
         if config.metric and (it + 1) % max(config.metric_freq, 1) == 0 \
                 and config.is_provide_training_metric:
             for mname in config.metric:
                 try:
                     m = create_metric(mname, config)
                     m.init(ds.metadata, n_local)
-                    local_vals = m.eval(score, objective)
+                    local_vals = m.eval(
+                        score[:, 0] if K == 1 else score, objective)
                     # sum-decomposable metrics reduce exactly; others
                     # (auc, ndcg) are per-shard approximations
                     red = _allreduce_sum([local_vals[0] * n_local,
@@ -140,6 +165,8 @@ def train(params: Dict, local_X: np.ndarray, local_y: np.ndarray,
     from ..boosting import create_boosting
     gbdt = create_boosting(config)
     gbdt.models = list(trees)
+    gbdt.num_class = K if objective.name.startswith("multiclass") else 1
+    gbdt.num_tree_per_iteration = K
     gbdt.max_feature_idx = local_X.shape[1] - 1
     gbdt.feature_names = list(ds.feature_names)
     gbdt.feature_infos = ds.feature_infos()

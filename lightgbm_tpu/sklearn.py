@@ -370,8 +370,12 @@ class LGBMClassifier(_LGBMClassifierBase, LGBMModel):
         self._n_classes = len(self._classes)
         self._fit_params_override = {}
         if self._n_classes > 2:
-            if not isinstance(self.objective, str) or \
-                    self.objective not in ("multiclass", "multiclassova"):
+            # promote string objectives to multiclass; custom callable
+            # objectives keep supplying their own gradients
+            if self.objective is None or (
+                    isinstance(self.objective, str)
+                    and self.objective not in ("multiclass",
+                                               "multiclassova")):
                 self._fit_params_override["objective"] = "multiclass"
             self._fit_params_override["num_class"] = self._n_classes
         y_enc = np.searchsorted(self._classes, y).astype(np.float64)

@@ -929,8 +929,16 @@ class SerialTreeLearner:
             return self.R // 2
         # +16 margin: counts travel as f32 sums and may round for very
         # large leaves. The floor caps compiled variants at ~log2(N) - 8.
-        return min(max(_next_pow2(int(count) + 16), _MIN_BUCKET),
-                   self._max_bucket)
+        S = min(max(_next_pow2(int(count) + 16), _MIN_BUCKET),
+                self._max_bucket)
+        if self._max_bucket >= (1 << 20):
+            # large datasets: even power-of-two exponents only — halves
+            # the number of compiled batch variants (each is a slow
+            # remote compile on the TPU tunnel) for ≤2x gather slack
+            e = S.bit_length() - 1
+            if (e & 1) and S < self._max_bucket:
+                S <<= 1
+        return min(S, self._max_bucket)
 
     # ------------------------------------------------------------------
     def _load_forced_splits(self, config):

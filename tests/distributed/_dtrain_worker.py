@@ -1,6 +1,7 @@
 """Full-boosting distributed worker (reference: dask.py _train_part —
 each worker trains the whole model on its shard, models agree). Spawned
-by tests/test_distributed_multiproc.py."""
+by tests/test_distributed_multiproc.py; argv[5] selects the objective
+mode ('binary' or 'multiclass')."""
 import sys
 
 import numpy as np
@@ -11,6 +12,7 @@ def main() -> None:
     nproc = int(sys.argv[2])
     port = sys.argv[3]
     out = sys.argv[4]
+    mode = sys.argv[5] if len(sys.argv) > 5 else "binary"
 
     import jax
     jax.distributed.initialize("127.0.0.1:%s" % port, nproc, rank)
@@ -20,13 +22,22 @@ def main() -> None:
     rng = np.random.RandomState(0)
     n, f = 600, 5
     X = rng.randn(n, f)
-    y = (X[:, 0] - 0.7 * X[:, 1] + 0.2 * rng.randn(n) > 0).astype(float)
     lo, hi = rank * (n // nproc), (rank + 1) * (n // nproc)
-    booster = dtrain.train(
-        {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
-         "bin_construct_sample_cnt": n, "verbosity": -1,
-         "learning_rate": 0.2},
-        X[lo:hi], y[lo:hi], num_boost_round=8)
+    if mode == "binary":
+        y = (X[:, 0] - 0.7 * X[:, 1]
+             + 0.2 * rng.randn(n) > 0).astype(float)
+        params = {"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 5, "bin_construct_sample_cnt": n,
+                  "verbosity": -1, "learning_rate": 0.2}
+    else:
+        score = np.stack([X[:, 0], X[:, 1], X[:, 2]], axis=1)
+        y = np.argmax(score + 0.2 * rng.randn(n, 3), axis=1).astype(float)
+        params = {"objective": "multiclass", "num_class": 3,
+                  "num_leaves": 15, "min_data_in_leaf": 5,
+                  "bin_construct_sample_cnt": n, "verbosity": -1,
+                  "learning_rate": 0.2}
+    booster = dtrain.train(params, X[lo:hi], y[lo:hi],
+                           num_boost_round=8)
     pred = booster.predict(X)  # every process predicts the FULL data
     with open(out + ".txt", "w") as fh:
         fh.write(booster.model_to_string())

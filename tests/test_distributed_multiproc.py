@@ -101,7 +101,8 @@ _DTRAIN_WORKER = os.path.join(os.path.dirname(__file__), "distributed",
 
 
 @pytest.mark.slow
-def test_two_process_full_boosting_matches_single(tmp_path):
+@pytest.mark.parametrize("mode", ["binary", "multiclass"])
+def test_two_process_full_boosting_matches_single(tmp_path, mode):
     """Full distributed boosting (parallel/dtrain.py train) produces the
     same model on both processes and tracks single-process lgb.train on
     the full data (reference: test_dask.py model-equivalence pattern)."""
@@ -110,7 +111,7 @@ def test_two_process_full_boosting_matches_single(tmp_path):
     outs = [str(tmp_path / ("d%d.npz" % r)) for r in range(nproc)]
     procs = [subprocess.Popen(
         [sys.executable, _DTRAIN_WORKER, str(r), str(nproc), str(port),
-         outs[r]],
+         outs[r], mode],
         env=_worker_env(2), stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True)
         for r in range(nproc)]
@@ -137,15 +138,28 @@ def test_two_process_full_boosting_matches_single(tmp_path):
     rng = np.random.RandomState(0)
     n, f = 600, 5
     X = rng.randn(n, f)
-    y = (X[:, 0] - 0.7 * X[:, 1] + 0.2 * rng.randn(n) > 0).astype(float)
-    bst = lgb.train(
-        {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
-         "bin_construct_sample_cnt": n, "verbosity": -1,
-         "learning_rate": 0.2},
-        lgb.Dataset(X, label=y), num_boost_round=8)
+    if mode == "binary":
+        y = (X[:, 0] - 0.7 * X[:, 1]
+             + 0.2 * rng.randn(n) > 0).astype(float)
+        params = {"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 5, "bin_construct_sample_cnt": n,
+                  "verbosity": -1, "learning_rate": 0.2}
+    else:
+        score = np.stack([X[:, 0], X[:, 1], X[:, 2]], axis=1)
+        y = np.argmax(score + 0.2 * rng.randn(n, 3),
+                      axis=1).astype(float)
+        params = {"objective": "multiclass", "num_class": 3,
+                  "num_leaves": 15, "min_data_in_leaf": 5,
+                  "bin_construct_sample_cnt": n, "verbosity": -1,
+                  "learning_rate": 0.2}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
     pred_single = bst.predict(X)
     np.testing.assert_allclose(w[0]["pred"], pred_single, rtol=5e-3,
                                atol=5e-3)
-    # distributed model separates classes about as well
-    sep = w[0]["pred"][y == 1].mean() - w[0]["pred"][y == 0].mean()
-    assert sep > 0.5
+    if mode == "binary":
+        sep = w[0]["pred"][y == 1].mean() - w[0]["pred"][y == 0].mean()
+        assert sep > 0.5
+    else:
+        acc = (np.argmax(w[0]["pred"], axis=1) == y).mean()
+        assert acc > 0.8
+        assert int(w[0]["n_trees"][0]) == 24  # 8 iters x 3 classes

@@ -114,19 +114,15 @@ def test_greedy_find_bin_matches_python():
             total = int(c.sum())
             native = greedy_find_bin(v, c, max_bin, total, mdib)
             assert native is not None
-            # force the pure-Python path by calling below the dispatch
-            # threshold logic: replicate its body via a tiny shim
-            py = binning._greedy_find_bin.__wrapped__(v, c, max_bin, total, mdib) \
-                if hasattr(binning._greedy_find_bin, "__wrapped__") else None
-            if py is None:
-                # no wrapper: temporarily disable native
-                import lightgbm_tpu.native as nat
-                orig = nat.greedy_find_bin
-                nat.greedy_find_bin = lambda *a, **k: None
-                try:
-                    py = binning._greedy_find_bin(v, c, max_bin, total,
-                                                  mdib)
-                finally:
-                    nat.greedy_find_bin = orig
+            # pure-Python path: disable the native dispatch (binning
+            # resolves the import at call time)
+            import lightgbm_tpu.native as nat
+            orig = nat.greedy_find_bin
+            nat.greedy_find_bin = lambda *a, **k: None
+            try:
+                py = binning._greedy_find_bin(v, c, max_bin, total,
+                                              mdib)
+            finally:
+                nat.greedy_find_bin = orig
             np.testing.assert_array_equal(np.asarray(native),
                                           np.asarray(py))

@@ -75,3 +75,32 @@ def test_object_dtype_int_labels_keep_type():
     clf = LGBMClassifier(n_estimators=4, num_leaves=7, verbosity=-1)
     clf.fit(X, y)
     assert (clf.predict(X) == np.asarray(y)).mean() > 0.9
+
+
+def test_callable_objective_multiclass_not_clobbered():
+    """A custom callable objective must survive multiclass promotion
+    (only num_class is injected) and drive num_class trees/iteration
+    (reference: custom fobj + LGBM_BoosterUpdateOneIterCustom)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 4)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    calls = []
+
+    def fobj(preds, train_data):
+        calls.append(1)
+        labels = train_data.get_label().astype(int)
+        K, n = 3, len(labels)
+        p = preds.reshape(K, n).T if preds.ndim == 1 else preds
+        e = np.exp(p - p.max(1, keepdims=True))
+        sm = e / e.sum(1, keepdims=True)
+        g = sm - np.eye(K)[labels]
+        h = sm * (1 - sm) * K / (K - 1)
+        return g.T.ravel(), h.T.ravel()
+
+    clf = LGBMClassifier(objective=fobj, n_estimators=6, num_leaves=7,
+                         verbosity=-1)
+    clf.fit(X, y)
+    assert calls, "custom objective never invoked"
+    raw = clf.predict(X, raw_score=True)
+    assert raw.shape == (400, 3)
+    assert (np.argmax(raw, axis=1) == y).mean() > 0.7
