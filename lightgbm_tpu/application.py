@@ -61,7 +61,7 @@ def _load_tabular(path: str, config: Config):
         from .native import parse_libsvm
         parsed = parse_libsvm(path)
         if parsed is not None:
-            return parsed[0], parsed[1], None
+            return parsed[0], parsed[1], None, None
         rows, labels = [], []
         max_idx = -1
         for line in open(path):
@@ -79,7 +79,7 @@ def _load_tabular(path: str, config: Config):
         for r, feats in enumerate(rows):
             for i, v in feats.items():
                 X[r, i] = v
-        return X, np.asarray(labels), None
+        return X, np.asarray(labels), None, None
     from .native import parse_dense
     data = parse_dense(path, delim, 1 if has_header else 0)
     if data is None:
@@ -98,14 +98,32 @@ def _load_tabular(path: str, config: Config):
     y = data[:, label_col]
     X = np.delete(data, label_col, axis=1)
     weights = None
+    group = None
+    drop: List[int] = []
     wc = str(config.weight_column)
     if wc and wc not in ("",):
         widx = int(wc) if not wc.startswith("name:") else None
         if widx is not None:
             # weight column index is post-label-removal per reference docs
             weights = X[:, widx]
-            X = np.delete(X, widx, axis=1)
-    return X, y, weights
+            drop.append(widx)
+    gc = str(getattr(config, "group_column", "") or "")
+    if gc and not gc.startswith("name:"):
+        # group column holds per-row query ids; contiguous runs become
+        # query sizes (reference: Metadata group_column semantics)
+        gidx = int(gc)
+        qid = X[:, gidx]
+        change = np.nonzero(np.diff(qid) != 0)[0] + 1
+        bounds = np.concatenate([[0], change, [len(qid)]])
+        group = np.diff(bounds).astype(np.int32)
+        drop.append(gidx)
+    for col in str(getattr(config, "ignore_column", "") or "").split(","):
+        col = col.strip()
+        if col and not col.startswith("name:"):
+            drop.append(int(col))
+    if drop:
+        X = np.delete(X, sorted(set(drop)), axis=1)
+    return X, y, weights, group
 
 
 def _sidecar(data_path: str, kind: str):
@@ -129,8 +147,8 @@ def run(argv: Optional[List[str]] = None) -> int:
     task = config.task
 
     if task == "train":
-        X, y, w = _load_tabular(config.data, config)
-        g = _sidecar(config.data, "query")
+        X, y, w, g = _load_tabular(config.data, config)
+        g = g if g is not None else _sidecar(config.data, "query")
         w = w if w is not None else _sidecar(config.data, "weight")
         ds = Dataset(X, label=y, weight=w, group=g, params=params)
         valid_sets = []
@@ -138,8 +156,8 @@ def run(argv: Optional[List[str]] = None) -> int:
         valid_paths = (config.valid if isinstance(config.valid, list)
                        else [v for v in str(config.valid).split(",") if v])
         for i, vpath in enumerate(valid_paths):
-            Xv, yv, wv = _load_tabular(vpath, config)
-            gv = _sidecar(vpath, "query")
+            Xv, yv, wv, gv = _load_tabular(vpath, config)
+            gv = gv if gv is not None else _sidecar(vpath, "query")
             wv = wv if wv is not None else _sidecar(vpath, "weight")
             valid_sets.append(Dataset(Xv, label=yv, weight=wv, group=gv,
                                       reference=ds, params=params))
@@ -157,7 +175,7 @@ def run(argv: Optional[List[str]] = None) -> int:
 
     if task in ("predict", "prediction", "test"):
         booster = Booster(params=params, model_file=config.input_model)
-        X, _, _ = _load_tabular(config.data, config)
+        X, _, _, _ = _load_tabular(config.data, config)
         pred = booster.predict(
             X, raw_score=bool(config.predict_raw_score),
             pred_leaf=bool(config.predict_leaf_index),
@@ -171,7 +189,7 @@ def run(argv: Optional[List[str]] = None) -> int:
 
     if task == "refit":
         booster = Booster(params=params, model_file=config.input_model)
-        X, y, _ = _load_tabular(config.data, config)
+        X, y, _, _ = _load_tabular(config.data, config)
         new_booster = booster  # refit leaves with new data
         from .boosting.refit import refit_model
         refit_model(new_booster.inner, X, y,
@@ -194,8 +212,8 @@ def run(argv: Optional[List[str]] = None) -> int:
         return 0
 
     if task == "save_binary":
-        X, y, w = _load_tabular(config.data, config)
-        ds = Dataset(X, label=y, weight=w, params=params)
+        X, y, w, g = _load_tabular(config.data, config)
+        ds = Dataset(X, label=y, weight=w, group=g, params=params)
         ds.construct()
         from .io.binary_io import save_binary
         save_binary(ds.handle, config.data + ".bin")

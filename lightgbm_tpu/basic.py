@@ -355,6 +355,19 @@ class Booster:
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
         X = _to_2d_float(data)
+        # predict_disable_shape_check (config.h:805): by default a
+        # feature-count mismatch is an error, not a silent misprediction
+        n_feat = self.inner.max_feature_idx + 1
+        if (X.ndim == 2 and X.shape[1] != n_feat
+                and not bool(kwargs.get(
+                    "predict_disable_shape_check",
+                    self.config.predict_disable_shape_check))):
+            raise ValueError(
+                "The number of features in data (%d) is not the same as "
+                "it was in training data (%d). You can set "
+                "predict_disable_shape_check=true to discard this "
+                "error, but please be aware what you are doing."
+                % (X.shape[1], n_feat))
         ni = -1 if num_iteration is None else int(num_iteration)
         if ni <= 0 and self.best_iteration > 0:
             ni = self.best_iteration

@@ -697,13 +697,18 @@ class GBDT:
         out = "\n".join(lines) + "\n"
         out += "".join(tree_strs)
         out += "end of trees\n"
-        imp = self.feature_importance("split", num_iteration)
-        pairs = [(int(imp[i]), self.feature_names[i])
+        # saved_feature_importance_type (config.h:586): 0=split, 1=gain
+        imp_type = ("gain" if int(getattr(
+            self.config, "saved_feature_importance_type", 0)) == 1
+            else "split")
+        imp = self.feature_importance(imp_type, num_iteration)
+        pairs = [(imp[i], self.feature_names[i])
                  for i in range(len(imp)) if imp[i] > 0]
         pairs.sort(key=lambda p: -p[0])
         out += "\nfeature_importances:\n"
         for v, name in pairs:
-            out += "%s=%d\n" % (name, v)
+            out += ("%s=%d\n" % (name, int(v)) if imp_type == "split"
+                    else "%s=%s\n" % (name, repr(float(v))))
         out += "\nparameters:\n%s\nend of parameters\n" % \
             self.config.to_param_string()
         return out

@@ -434,6 +434,10 @@ class Config:
         if self.two_round:
             log.warning("two_round loading is a CPU-memory staging hint "
                         "with no effect in this build")
+        if self.parser_config_file:
+            log.warning("parser_config_file (custom parser plugins) is "
+                        "not supported; the built-in CSV/TSV/LibSVM "
+                        "parsers are used")
         if self.force_col_wise or self.force_row_wise:
             log.warning("force_col_wise/force_row_wise are CPU histogram "
                         "layout hints; the TPU build always uses one "

@@ -19,6 +19,19 @@ from .config import Config
 from .utils import log
 
 
+def _pop_callable_objective(params: Dict[str, Any]):
+    """Extract a callable objective from a params dict IN PLACE,
+    replacing it with "none" (Config only understands strings); returns
+    the callable or None. Callables can arrive via train()'s params or
+    ride in on the Dataset's own params (e.g. from the sklearn
+    wrapper)."""
+    obj = params.get("objective")
+    if callable(obj):
+        params["objective"] = "none"
+        return obj
+    return None
+
+
 def train(params: Dict[str, Any], train_set: Dataset,
           num_boost_round: int = 100,
           valid_sets: Optional[List[Dataset]] = None,
@@ -29,10 +42,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
           callbacks: Optional[List[Callable]] = None) -> Booster:
     """reference: engine.py:36."""
     params = dict(params or {})
-    fobj = None
-    if callable(params.get("objective")):
-        fobj = params["objective"]
-        params["objective"] = "none"
+    fobj = _pop_callable_objective(params)
     # num_boost_round may come via params aliases
     cfg = Config.from_params(params)
     if "num_iterations" in params or any(
@@ -42,10 +52,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
         num_boost_round = cfg.num_iterations
 
     merged = dict(params, **(train_set.params or {}))
-    if callable(merged.get("objective")):
-        # a callable can ride in via the Dataset's own params (e.g. the
-        # sklearn wrapper); Config only understands strings
-        merged["objective"] = "none"
+    _pop_callable_objective(merged)
     train_set.params = merged
     train_set.construct()
 
@@ -241,14 +248,12 @@ def cv(params: Dict[str, Any], train_set: Dataset,
     params = dict(params or {})
     if metrics is not None:
         params["metric"] = metrics
+    fobj = _pop_callable_objective(params)
     cfg = Config.from_params(params)
     if cfg.objective not in ("binary", "multiclass", "multiclassova"):
         stratified = False
     merged = dict(params, **(train_set.params or {}))
-    if callable(merged.get("objective")):
-        # a callable can ride in via the Dataset's own params (e.g. the
-        # sklearn wrapper); Config only understands strings
-        merged["objective"] = "none"
+    _pop_callable_objective(merged)
     train_set.params = merged
     train_set.construct()
     folds_idx = _make_n_folds(train_set, folds, nfold, params,
@@ -287,7 +292,7 @@ def cv(params: Dict[str, Any], train_set: Dataset,
                 evaluation_result_list=None))
         raw = []
         for bst, with_train in fold_data:
-            bst.update()
+            bst.update(fobj=fobj)
             one = []
             if with_train:
                 one.extend(bst.eval_train(feval))

@@ -184,6 +184,22 @@ class BinnedDataset:
                                                  num_total_features))
                 if min(max_bin_by_feature) <= 1:
                     log.fatal("Each entry of max_bin_by_feature must be > 1")
+            # forcedbins_filename (config.h:740): JSON list of
+            # {"feature": i, "bin_upper_bound": [...]} entries
+            # (reference: DatasetLoader reads it into forced_bins then
+            # BinMapper::FindBin applies FindBinWithPredefinedBin)
+            forced_bounds: dict = {}
+            if getattr(config, "forcedbins_filename", ""):
+                import json
+                try:
+                    with open(config.forcedbins_filename) as fh:
+                        for entry in json.load(fh):
+                            forced_bounds[int(entry["feature"])] = [
+                                float(v)
+                                for v in entry["bin_upper_bound"]]
+                except (OSError, ValueError, KeyError, TypeError) as e:
+                    log.warning("Cannot load forced bins from %s: %s"
+                                % (config.forcedbins_filename, e))
             mappers: List[BinMapper] = []
             sample_bin_cols: List[np.ndarray] = []
             for f in range(num_total_features):
@@ -201,7 +217,8 @@ class BinnedDataset:
                     bin_type=(BinType.CATEGORICAL if f in cat_set
                               else BinType.NUMERICAL),
                     use_missing=config.use_missing,
-                    zero_as_missing=config.zero_as_missing)
+                    zero_as_missing=config.zero_as_missing,
+                    forced_upper_bounds=forced_bounds.get(f))
                 mappers.append(bm)
                 if not bm.is_trivial:
                     sample_bin_cols.append(

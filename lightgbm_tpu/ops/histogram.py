@@ -37,6 +37,31 @@ DEFAULT_ROW_TILE = 512
 PALLAS_ROW_TILE = 2048
 
 
+def resolve_hist_impl(backend: str = "auto",
+                      f64: bool = False) -> tuple:
+    """Validate Config.hist_backend / Config.tpu_use_f64_hist into a
+    static (backend, f64) pair the learners thread through their
+    compiled-step cache keys (the latter is the analogue of the
+    reference's gpu_use_dp, docs/GPU-Performance.rst). f64 accumulation
+    requires jax_enable_x64 and disables the Pallas kernel (f32-only)."""
+    backend = (backend or "auto").lower()
+    if backend == "scatter":
+        from ..utils import log
+        log.warning("hist_backend=scatter is a CPU concept; using the "
+                    "one-hot contraction instead")
+        backend = "onehot"
+    if backend not in ("auto", "onehot", "pallas"):
+        from ..utils import log
+        log.warning("unknown hist_backend=%s; using auto" % backend)
+        backend = "auto"
+    if f64 and not jax.config.jax_enable_x64:
+        from ..utils import log
+        log.warning("tpu_use_f64_hist needs jax_enable_x64; histograms "
+                    "stay f32")
+        f64 = False
+    return backend, bool(f64)
+
+
 @functools.lru_cache(maxsize=1)
 def _use_pallas() -> bool:
     """Pallas path only on real TPU backends; the einsum-scan fallback
@@ -67,13 +92,16 @@ def _use_pallas() -> bool:
 
 def _tile_histogram(bins_tile: jnp.ndarray, gh_tile: jnp.ndarray,
                     num_bins: int) -> jnp.ndarray:
-    """[T, F] uint bins x [T, C] stats -> [F, B, C] partial histogram."""
+    """[T, F] uint bins x [T, C] stats -> [F, B, C] partial histogram.
+    Accumulates in gh's dtype (f64 under tpu_use_f64_hist, else f32)."""
+    acc_dtype = (jnp.float64 if gh_tile.dtype == jnp.float64
+                 else jnp.float32)
     onehot = (bins_tile.astype(jnp.int32)[:, :, None]
               == jnp.arange(num_bins, dtype=jnp.int32)[None, None, :])
     return jnp.einsum(
-        "tfb,tc->fbc", onehot.astype(jnp.float32), gh_tile,
+        "tfb,tc->fbc", onehot.astype(acc_dtype), gh_tile,
         precision=jax.lax.Precision.HIGHEST,
-        preferred_element_type=jnp.float32)
+        preferred_element_type=acc_dtype)
 
 
 def _hist_kernel_body(T: int, F: int, H: int, C: int, bins_ref, gh_ref,
@@ -155,7 +183,8 @@ def _pallas_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
 
 def build_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
                     row_tile: int = DEFAULT_ROW_TILE,
-                    pallas_ok: bool = True) -> jnp.ndarray:
+                    pallas_ok: bool = True,
+                    hist_impl: tuple = ("auto", False)) -> jnp.ndarray:
     """Accumulate (grad, hess, count) per (feature, bin).
 
     Parameters
@@ -168,15 +197,23 @@ def build_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
         pass False — pallas_call has no SPMD partitioning rule, so GSPMD
         would all-gather the full bins array per device; the einsum path
         partitions cleanly and lets XLA insert the psum.
+    hist_impl : STATIC (backend, f64) from resolve_hist_impl — callers
+        thread it through their compiled-fn cache keys so a setting is
+        never baked stale into a cached trace.
 
     Returns f32 [F, B, C].
     """
+    backend, f64 = hist_impl
     S, F = bins.shape
     C = gh.shape[1]
-    if pallas_ok and _use_pallas() and S >= PALLAS_ROW_TILE and C <= 8:
+    if (pallas_ok and not f64 and backend != "onehot"
+            and _use_pallas() and S >= PALLAS_ROW_TILE and C <= 8):
         return _pallas_histogram(bins, gh, num_bins, PALLAS_ROW_TILE)
+    if f64:
+        gh = gh.astype(jnp.float64)
+    acc_dtype = jnp.float64 if f64 else jnp.float32
     if S <= row_tile:
-        return _tile_histogram(bins, gh, num_bins)
+        return _tile_histogram(bins, gh, num_bins).astype(jnp.float32)
     # Pad S to a tile multiple; padded rows use gh = 0 so they vanish.
     pad = (-S) % row_tile
     if pad:
@@ -191,9 +228,9 @@ def build_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
         b, g = xs
         return acc + _tile_histogram(b, g, num_bins), None
 
-    init = jnp.zeros((F, num_bins, C), dtype=jnp.float32)
+    init = jnp.zeros((F, num_bins, C), dtype=acc_dtype)
     hist, _ = jax.lax.scan(step, init, (bins_t, gh_t))
-    return hist
+    return hist.astype(jnp.float32)
 
 
 def subtract_histogram(parent: jnp.ndarray, child: jnp.ndarray) -> jnp.ndarray:

@@ -75,6 +75,20 @@ def train(params: Dict, local_X: np.ndarray, local_y: np.ndarray,
     learner = DistributedDataParallelLearner(config, ds, mesh)
 
     objective = create_objective(config.objective, config)
+    obj_name = str(config.objective)
+    if obj_name == "binary" or obj_name.startswith("multiclass"):
+        # per-class state (need_train, is_unbalance weights) derives
+        # from LOCAL labels only; a shard missing a class would silently
+        # zero that class's gradients on this rank — fail loudly instead
+        # (reference analogue: pre-partitioned distributed data must
+        # keep label coverage per rank)
+        present = set(np.unique(local_y.astype(np.int64)))
+        expected = (set(range(max(int(config.num_class), 2)))
+                    if obj_name.startswith("multiclass") else {0, 1})
+        if not expected <= present:
+            log.fatal("local shard is missing classes %s; distributed "
+                      "training needs every class on every shard"
+                      % sorted(expected - present))
     objective.init(ds.metadata, n_local)
 
     K = max(int(objective.num_tree_per_iteration), 1)

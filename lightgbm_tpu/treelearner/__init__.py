@@ -26,7 +26,17 @@ def create_tree_learner(config, dataset, mesh=None):
                 "tree_learner=%s requested but only one device is "
                 "visible; falling back to serial" % name)
             return SerialTreeLearner(config, dataset)
-        mesh = make_mesh()
+        # mesh_shape (e.g. "data=8") bounds the device count; the
+        # 1-D GBDT learners use the first axis extent
+        n_dev = None
+        shape = str(getattr(config, "mesh_shape", "") or "")
+        if shape:
+            try:
+                n_dev = int(shape.split(",")[0].split("=")[1])
+            except (IndexError, ValueError):
+                log.warning("cannot parse mesh_shape=%r; using all "
+                            "devices" % shape)
+        mesh = make_mesh(n_dev)
     if name in ("data", "data_parallel"):
         return DataParallelTreeLearner(config, dataset, mesh)
     if name in ("feature", "feature_parallel"):

@@ -302,13 +302,14 @@ class BundleTables(NamedTuple):
 
 
 def _leaf_histogram(bins, gh, meta, btab, *, B: int, Bg: int,
-                    bundled: bool, totals=None):
+                    bundled: bool, totals=None,
+                    hist_impl: tuple = ("auto", False)):
     """Histogram of (a subset of) rows → per-feature [Fp, B, 4].
     Bundled mode histograms the [*, G] bundle matrix at Bg bins then
     unpacks (totals = the leaf's channel sums for zero-bin rows)."""
     if not bundled:
-        return build_histogram(bins, gh, B)
-    bhist = build_histogram(bins, gh, Bg)
+        return build_histogram(bins, gh, B, hist_impl=hist_impl)
+    bhist = build_histogram(bins, gh, Bg, hist_impl=hist_impl)
     if totals is None:
         totals = jnp.sum(gh, axis=0)
     return unpack_bundle_histogram(bhist, btab.gidx_g, btab.gidx_b,
@@ -329,7 +330,8 @@ def _partition_col(bins, f, meta, btab, bundled: bool):
 def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
                 valid, mask_left, mask_right, meta, params, btab, *,
                 S: int, B: int, Bg: int, bundled: bool, max_depth: int,
-                extra_trees: bool, has_cat: bool = True, children_allowed=None,
+                extra_trees: bool, has_cat: bool = True,
+                hist_impl: tuple = ("auto", False), children_allowed=None,
                 rand_seed=0, pen_left=None, pen_right=None) -> GrowState:
     """Apply one split (already chosen: ``rec`` at ``leaf``) and scan both
     children. Shared by the per-split and batched paths.
@@ -357,7 +359,8 @@ def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
                   rec.right_total_count)])
     hist_small = _leaf_histogram(bins[idx], state.gh[idx], meta, btab,
                                  B=B, Bg=Bg, bundled=bundled,
-                                 totals=small_totals)
+                                 totals=small_totals,
+                                 hist_impl=hist_impl)
     hist_large = subtract_histogram(state.hists[leaf], hist_small)
     hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
     hist_right = jnp.where(smaller_is_left, hist_large, hist_small)
@@ -406,13 +409,15 @@ def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
 
 @functools.lru_cache(maxsize=None)
 def _root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
-                    extra_trees: bool, has_cat: bool = True):
+                    extra_trees: bool, has_cat: bool = True,
+                    hist_impl: tuple = ("auto", False)):
     def root(bins, gh, leaf_of_row0, feature_mask, children_allowed,
              rand_seed, meta, params, btab):
         F = meta.num_bin.shape[0]
         sums = jnp.sum(gh, axis=0)
         hist = _leaf_histogram(bins, gh, meta, btab, B=B, Bg=Bg,
-                               bundled=bundled, totals=sums)
+                               bundled=bundled, totals=sums,
+                               hist_impl=hist_impl)
         # root "parent" output: its own unsmoothed output (reference:
         # SerialTreeLearner::GetParentOutput, serial_tree_learner.cpp:786)
         parent_out = calculate_leaf_output(sums[0], sums[1], params)
@@ -431,7 +436,8 @@ def _root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
 
 @functools.lru_cache(maxsize=None)
 def _step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
-                    extra_trees: bool, has_cat: bool = True):
+                    extra_trees: bool, has_cat: bool = True,
+                    hist_impl: tuple = ("auto", False)):
     """Per-split step (host chooses the leaf): used when per-node feature
     masks (interaction constraints / bynode sampling) force a host
     round-trip per split."""
@@ -443,6 +449,7 @@ def _step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
                             meta, params, btab, S=S, B=B, Bg=Bg,
                             bundled=bundled, max_depth=0,
                             extra_trees=extra_trees, has_cat=has_cat,
+                            hist_impl=hist_impl,
                             children_allowed=children_allowed,
                             rand_seed=rand_seed)
         best = jnp.argmax(state.gain).astype(jnp.int32)
@@ -465,13 +472,15 @@ def _cegb_penalty(params, count, used, coupled, unfetched, lazy):
 
 @functools.lru_cache(maxsize=None)
 def _cegb_root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
-                         has_lazy: bool, has_cat: bool = True):
+                         has_lazy: bool, has_cat: bool = True,
+                         hist_impl: tuple = ("auto", False)):
     def root(bins, gh, leaf_of_row0, feature_mask, children_allowed,
              used, fetched, coupled, lazy, meta, params, btab):
         F = meta.num_bin.shape[0]
         sums = jnp.sum(gh, axis=0)
         hist = _leaf_histogram(bins, gh, meta, btab, B=B, Bg=Bg,
-                               bundled=bundled, totals=sums)
+                               bundled=bundled, totals=sums,
+                               hist_impl=hist_impl)
         parent_out = calculate_leaf_output(sums[0], sums[1], params)
         if has_lazy:
             in_rows = (leaf_of_row0 >= 0).astype(jnp.float32)
@@ -493,7 +502,8 @@ def _cegb_root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
 
 @functools.lru_cache(maxsize=None)
 def _cegb_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
-                         has_lazy: bool, has_cat: bool = True):
+                         has_lazy: bool, has_cat: bool = True,
+                         hist_impl: tuple = ("auto", False)):
     """Per-split CEGB step: applies the pending split, updates the
     used-features vector and (lazy mode) the per-(row, feature) fetched
     matrix, and scans both children with penalized gains (reference:
@@ -540,6 +550,7 @@ def _cegb_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
                             meta, params, btab, S=S, B=B, Bg=Bg,
                             bundled=bundled, max_depth=0,
                             extra_trees=False, has_cat=has_cat,
+                            hist_impl=hist_impl,
                             children_allowed=children_allowed,
                             pen_left=pen_l, pen_right=pen_r)
         best = jnp.argmax(state.gain).astype(jnp.int32)
@@ -550,7 +561,8 @@ def _cegb_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
 
 @functools.lru_cache(maxsize=None)
 def _mono_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
-                         has_cat: bool = True):
+                         has_cat: bool = True,
+                         hist_impl: tuple = ("auto", False)):
     """Per-split step for monotone_constraints_method=intermediate: the
     children's output bounds come from the host tracker (sibling-output
     based, monotone_constraints.hpp:543) instead of the mid-point rule
@@ -568,6 +580,7 @@ def _mono_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
                             meta, params, btab, S=S, B=B, Bg=Bg,
                             bundled=bundled, max_depth=0,
                             extra_trees=False, has_cat=has_cat,
+                            hist_impl=hist_impl,
                             children_allowed=children_allowed)
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), state.gain
@@ -600,7 +613,8 @@ def _rescan_fn_cached(B: int, has_cat: bool = True):
 
 @functools.lru_cache(maxsize=None)
 def _forced_fn_cached(S: int, B: int, Bg: int, bundled: bool,
-                      extra_trees: bool, has_cat: bool = True):
+                      extra_trees: bool, has_cat: bool = True,
+                      hist_impl: tuple = ("auto", False)):
     """Forced split of a given (feature, threshold-bin) on a leaf
     (reference: SerialTreeLearner::ForceSplits,
     serial_tree_learner.cpp:451): the split record is built from the
@@ -640,7 +654,7 @@ def _forced_fn_cached(S: int, B: int, Bg: int, bundled: bool,
                             feature_mask, feature_mask, meta, params,
                             btab, S=S, B=B, Bg=Bg, bundled=bundled,
                             max_depth=0, extra_trees=extra_trees,
-                            has_cat=has_cat,
+                            has_cat=has_cat, hist_impl=hist_impl,
                             children_allowed=children_allowed,
                             rand_seed=rand_seed)
         return state, rec, ok
@@ -651,7 +665,8 @@ def _forced_fn_cached(S: int, B: int, Bg: int, bundled: bool,
 @functools.lru_cache(maxsize=None)
 def _batch_fn_cached(S: int, kb: int, B: int, Bg: int, bundled: bool,
                      max_depth: int, extra_trees: bool,
-                     has_cat: bool = True):
+                     has_cat: bool = True,
+                     hist_impl: tuple = ("auto", False)):
     """Batched split steps: one dispatch runs kb splits, the device
     picking the best leaf each step (the argmax the reference does on host
     at serial_tree_learner.cpp:194). Records of the applied splits are
@@ -672,6 +687,7 @@ def _batch_fn_cached(S: int, kb: int, B: int, Bg: int, bundled: bool,
                                 btab, S=S, B=B, Bg=Bg, bundled=bundled,
                                 max_depth=max_depth,
                                 extra_trees=extra_trees, has_cat=has_cat,
+                                hist_impl=hist_impl,
                                 rand_seed=rand_seed)
             return state, recs
 
@@ -707,6 +723,10 @@ class SerialTreeLearner:
         # nonzero-gather fill target.
         self.R = -(-(N + 1) // 4096) * 4096
         self.Fp = -(-F // 8) * 8
+        from ..ops.histogram import resolve_hist_impl
+        self._hist_impl = resolve_hist_impl(
+            getattr(config, "hist_backend", "auto"),
+            bool(getattr(config, "tpu_use_f64_hist", False)))
         self._bundled = dataset.bundle is not None
         ncols = (dataset.bundle.num_groups if self._bundled else F)
         self.Gp = -(-ncols // 8) * 8
@@ -738,7 +758,7 @@ class SerialTreeLearner:
         self._has_cat = bool(np.asarray(self.meta.is_categorical).any())
         self._root_fn = _root_fn_cached(self.L, self.B, self.Bg,
                                         self._bundled, self._extra_trees,
-                                        self._has_cat)
+                                        self._has_cat, self._hist_impl)
         self._forced = self._load_forced_splits(config)
         self._init_cegb(config)
         self._init_monotone(config)
@@ -905,13 +925,14 @@ class SerialTreeLearner:
 
     def _step_fn(self, S: int):
         return _step_fn_cached(S, self.B, self.Bg, self._bundled,
-                               self._extra_trees, self._has_cat)
+                               self._extra_trees, self._has_cat,
+                               self._hist_impl)
 
     def _batch_fn(self, S: int):
         kb = self._batch_k(S)
         return (_batch_fn_cached(S, kb, self.B, self.Bg, self._bundled,
                                  self.max_depth, self._extra_trees,
-                                 self._has_cat), kb)
+                                 self._has_cat, self._hist_impl), kb)
 
     def _batch_k(self, S: int) -> int:
         """Steps per dispatch: aim for ~4R gathered rows per batch so early
@@ -976,7 +997,8 @@ class SerialTreeLearner:
             M = max(leaf_total.values())
             S = self._bucket(M / 2)
             fn = _forced_fn_cached(S, self.B, self.Bg, self._bundled,
-                                   self._extra_trees, self._has_cat)
+                                   self._extra_trees, self._has_cat,
+                                   self._hist_impl)
             allowed = self._splittable(int(tree.leaf_depth[leaf]) + 1)
             state, rec, ok = fn(self.bins, state, jnp.int32(leaf),
                                 jnp.int32(next_leaf), jnp.int32(inner),
@@ -1092,7 +1114,7 @@ class SerialTreeLearner:
                         "feature masks")
         root = _cegb_root_fn_cached(self.L, self.B, self.Bg,
                                     self._bundled, self._cegb_has_lazy,
-                                    self._has_cat)
+                                    self._has_cat, self._hist_impl)
         state, rec = root(self.bins, gh, self._leaf_of_row0, feature_mask,
                           self._splittable(0), self._cegb_used,
                           self._cegb_fetched, self._cegb_coupled,
@@ -1110,7 +1132,7 @@ class SerialTreeLearner:
             S = self._bucket(smaller)
             fn = _cegb_step_fn_cached(S, self.B, self.Bg, self._bundled,
                                       self._cegb_has_lazy,
-                                      self._has_cat)
+                                      self._has_cat, self._hist_impl)
             state, rec, self._cegb_used, self._cegb_fetched = fn(
                 self.bins, state, jnp.int32(leaf), jnp.int32(k),
                 jnp.asarray(children_allowed), feature_mask,
@@ -1136,7 +1158,8 @@ class SerialTreeLearner:
         # extra_trees is ignored on this path — the root scan must be
         # greedy too, not just the step scans
         root_fn = _root_fn_cached(self.L, self.B, self.Bg, self._bundled,
-                                  False, self._has_cat)
+                                  False, self._has_cat,
+                                  self._hist_impl)
         state, rec = root_fn(self.bins, gh, self._leaf_of_row0,
                              feature_mask, self._splittable(0),
                              rand_seed, self.meta, self.params,
@@ -1180,7 +1203,8 @@ class SerialTreeLearner:
                           float(pending.right_total_count))
             S = self._bucket(smaller)
             fn = _mono_step_fn_cached(S, self.B, self.Bg,
-                                      self._bundled, self._has_cat)
+                                      self._bundled, self._has_cat,
+                                      self._hist_impl)
             applied_tbin = int(pending.threshold_bin)
             applied_numerical = not bool(pending.is_categorical)
             state, rec, gains_d = fn(

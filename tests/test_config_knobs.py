@@ -1,0 +1,112 @@
+"""Tests for the long-tail config knobs wired this round:
+forcedbins_filename, saved_feature_importance_type, ignore_column /
+group_column in the CLI loader, predict_disable_shape_check,
+hist_backend / tpu_use_f64_hist."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=600, f=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X[:, 0] * 2 + 0.1 * rng.randn(n)
+    return X, y
+
+
+def test_forcedbins_filename(tmp_path):
+    """reference: forcedbins_filename (config.h:740) pins bin upper
+    bounds for chosen features."""
+    X, y = _data()
+    fb = str(tmp_path / "forced.json")
+    with open(fb, "w") as fh:
+        json.dump([{"feature": 0, "bin_upper_bound": [-1.0, 0.0, 1.0]}],
+                  fh)
+    ds = lgb.Dataset(X, label=y,
+                     params={"forcedbins_filename": fb,
+                             "verbosity": -1})
+    ds.construct()
+    ub = ds.handle.bin_mappers[0].bin_upper_bound
+    for forced in (-1.0, 0.0, 1.0):
+        assert any(abs(b - forced) < 1e-9 for b in ub), \
+            "forced bound %r missing from %s" % (forced, ub)
+
+
+def test_saved_feature_importance_type():
+    X, y = _data()
+    params = {"objective": "regression", "num_leaves": 15,
+              "verbosity": -1}
+    b_split = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=5)
+    b_gain = lgb.train(dict(params, saved_feature_importance_type=1),
+                       lgb.Dataset(X, label=y), num_boost_round=5)
+    s_split = b_split.model_to_string()
+    s_gain = b_gain.model_to_string()
+    sec = lambda s: s.split("feature_importances:")[1].split(
+        "parameters:")[0].strip().splitlines()
+    # split importances are integers; gain importances carry decimals
+    assert all(float(l.split("=")[1]) == int(float(l.split("=")[1]))
+               for l in sec(s_split))
+    assert any("." in l.split("=")[1] for l in sec(s_gain))
+
+
+def test_cli_ignore_and_group_column(tmp_path):
+    from lightgbm_tpu.application import _load_tabular
+    from lightgbm_tpu.config import Config
+    n = 120
+    rng = np.random.RandomState(3)
+    qid = np.repeat(np.arange(6), 20)
+    arr = np.column_stack([rng.rand(n),           # label
+                           qid,                   # group column (idx 0)
+                           rng.randn(n),          # feature
+                           np.arange(n),          # ignored (idx 2)
+                           rng.randn(n)])         # feature
+    path = str(tmp_path / "t.csv")
+    np.savetxt(path, arr, delimiter=",", fmt="%.8g")
+    cfg = Config.from_params({"group_column": "0", "ignore_column": "2"})
+    X, y, w, g = _load_tabular(path, cfg)
+    assert X.shape == (n, 2)
+    np.testing.assert_array_equal(g, [20] * 6)
+    np.testing.assert_allclose(y, arr[:, 0])
+    np.testing.assert_allclose(X[:, 0], arr[:, 2])
+    np.testing.assert_allclose(X[:, 1], arr[:, 4])
+
+
+def test_predict_shape_check():
+    X, y = _data()
+    bst = lgb.train({"objective": "regression", "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    with pytest.raises(ValueError, match="number of features"):
+        bst.predict(X[:, :3])
+    # disabling the check lets the narrower matrix through (reference:
+    # predict_disable_shape_check, config.h:805) — extra features at
+    # the end are simply unused by the trees
+    wide = np.column_stack([X, np.zeros(len(X))])
+    with pytest.raises(ValueError):
+        bst.predict(wide)
+    out = bst.predict(wide, predict_disable_shape_check=True)
+    np.testing.assert_allclose(out, bst.predict(X), rtol=1e-12)
+
+
+def test_hist_backend_and_f64_warns(capsys):
+    X, y = _data()
+    # hist_backend=onehot trains identically (pallas is TPU-only here
+    # anyway); scatter warns and degrades
+    a = lgb.train({"objective": "regression", "verbosity": -1,
+                   "hist_backend": "onehot"},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    b = lgb.train({"objective": "regression", "verbosity": 1,
+                   "hist_backend": "scatter"},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    np.testing.assert_allclose(a.predict(X), b.predict(X), rtol=1e-12)
+    assert "hist_backend=scatter" in capsys.readouterr().err
+    # f64 without x64 warns and stays f32
+    c = lgb.train({"objective": "regression", "verbosity": 1,
+                   "tpu_use_f64_hist": True},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    assert "jax_enable_x64" in capsys.readouterr().err
+    np.testing.assert_allclose(c.predict(X), a.predict(X), rtol=1e-12)

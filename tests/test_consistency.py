@@ -42,8 +42,9 @@ class FileLoader:
         cfg = Config.from_params({k: v for k, v in self.params.items()
                                   if k not in ("config",)})
         path = os.path.join(self.directory, self.prefix + name)
-        X, y, w = _load_tabular(path, cfg)
-        g = _sidecar(path, "query")
+        X, y, w, g = _load_tabular(path, cfg)
+        if g is None:
+            g = _sidecar(path, "query")
         return X, y, w, g
 
 

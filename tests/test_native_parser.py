@@ -56,7 +56,7 @@ def test_cli_uses_native_parser(tmp_path):
                            rng.randn(300, 4)])
     np.savetxt(p, arr, delimiter=",", fmt="%.10g")
     cfg = Config.from_params({})
-    X, y, w = app._load_tabular(str(p), cfg)
+    X, y, w, g = app._load_tabular(str(p), cfg)
     np.testing.assert_allclose(y, arr[:, 0])
     np.testing.assert_allclose(X, arr[:, 1:], rtol=1e-9)
 
