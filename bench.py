@@ -166,6 +166,11 @@ def run_bench() -> dict:
     auc = m.eval(np.asarray(booster.train_score[:, 0]),
                  booster.objective)[0]
 
+    # record which histogram kernel actually ran (the Pallas path
+    # self-probes and may silently fall back to the einsum scan)
+    from lightgbm_tpu.ops.histogram import _use_pallas
+    kernel = "pallas" if _use_pallas() else "einsum"
+
     rows_note = ("" if n_rows == HIGGS_ROWS
                  else " [NOT full Higgs scale; vs_baseline reported 0]")
     fb_note = " [CPU FALLBACK: %s]" % fallback if fallback else ""
@@ -175,11 +180,11 @@ def run_bench() -> dict:
     return {
         "metric": "higgs_boosting_iters_per_sec_per_chip",
         "value": round(iters_per_sec, 4),
-        "unit": "iters/s on %s (%.1fM rows x 28f, 255 leaves, 255 bins, "
-                "%d+%d iters; train AUC %.6f; bin %.0fs warmup %.0fs "
-                "train %.0fs)%s%s"
-                % (platform, n_rows / 1e6, warmup, done, auc, t_bin,
-                   t_warm, t_train, rows_note, fb_note),
+        "unit": "iters/s on %s/%s (%.1fM rows x 28f, 255 leaves, 255 "
+                "bins, %d+%d iters; train AUC %.6f; bin %.0fs warmup "
+                "%.0fs train %.0fs)%s%s"
+                % (platform, kernel, n_rows / 1e6, warmup, done, auc,
+                   t_bin, t_warm, t_train, rows_note, fb_note),
         "vs_baseline": round(vs, 4),
     }
 

@@ -102,13 +102,19 @@ def _load_tabular(path: str, config: Config):
     drop: List[int] = []
     wc = str(config.weight_column)
     if wc and wc not in ("",):
-        widx = int(wc) if not wc.startswith("name:") else None
-        if widx is not None:
+        if wc.startswith("name:"):
+            log.warning("weight_column by name needs a header-aware "
+                        "loader; IGNORED (use a column index)")
+        else:
             # weight column index is post-label-removal per reference docs
+            widx = int(wc)
             weights = X[:, widx]
             drop.append(widx)
     gc = str(getattr(config, "group_column", "") or "")
-    if gc and not gc.startswith("name:"):
+    if gc and gc.startswith("name:"):
+        log.warning("group_column by name needs a header-aware loader; "
+                    "IGNORED (use a column index)")
+    elif gc:
         # group column holds per-row query ids; contiguous runs become
         # query sizes (reference: Metadata group_column semantics)
         gidx = int(gc)
@@ -119,7 +125,10 @@ def _load_tabular(path: str, config: Config):
         drop.append(gidx)
     for col in str(getattr(config, "ignore_column", "") or "").split(","):
         col = col.strip()
-        if col and not col.startswith("name:"):
+        if col and col.startswith("name:"):
+            log.warning("ignore_column by name needs a header-aware "
+                        "loader; IGNORED (use column indices)")
+        elif col:
             drop.append(int(col))
     if drop:
         X = np.delete(X, sorted(set(drop)), axis=1)
@@ -213,6 +222,8 @@ def run(argv: Optional[List[str]] = None) -> int:
 
     if task == "save_binary":
         X, y, w, g = _load_tabular(config.data, config)
+        g = g if g is not None else _sidecar(config.data, "query")
+        w = w if w is not None else _sidecar(config.data, "weight")
         ds = Dataset(X, label=y, weight=w, group=g, params=params)
         ds.construct()
         from .io.binary_io import save_binary

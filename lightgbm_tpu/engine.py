@@ -52,7 +52,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
         num_boost_round = cfg.num_iterations
 
     merged = dict(params, **(train_set.params or {}))
-    _pop_callable_objective(merged)
+    ds_fobj = _pop_callable_objective(merged)  # always pop (Config
+    fobj = fobj or ds_fobj                     # can't parse callables)
     train_set.params = merged
     train_set.construct()
 
@@ -253,7 +254,8 @@ def cv(params: Dict[str, Any], train_set: Dataset,
     if cfg.objective not in ("binary", "multiclass", "multiclassova"):
         stratified = False
     merged = dict(params, **(train_set.params or {}))
-    _pop_callable_objective(merged)
+    ds_fobj = _pop_callable_objective(merged)  # always pop (Config
+    fobj = fobj or ds_fobj                     # can't parse callables)
     train_set.params = merged
     train_set.construct()
     folds_idx = _make_n_folds(train_set, folds, nfold, params,

@@ -114,6 +114,10 @@ class DataParallelTreeLearner:
         self.params = jax.device_put(SplitParams.from_config(config),
                                      self.rep_sharding)
         self._ff_rng = np.random.RandomState(config.feature_fraction_seed)
+        from ..ops.histogram import resolve_hist_impl
+        self._hist_impl = resolve_hist_impl(
+            getattr(config, "hist_backend", "auto"),
+            bool(getattr(config, "tpu_use_f64_hist", False)))
         self._has_cat = bool(
             np.asarray(self.meta.is_categorical).any())
         self._root_fn = None
@@ -161,7 +165,8 @@ class DataParallelTreeLearner:
                                                 self.row_sharding)
 
     def _root_impl(self, bins, gh, feature_mask, children_allowed):
-        hist = build_histogram(bins, gh, self.B, pallas_ok=False)
+        hist = build_histogram(bins, gh, self.B, pallas_ok=False,
+                               hist_impl=self._hist_impl)
         hist = jax.lax.with_sharding_constraint(hist, self.hist_sharding)
         sums = jnp.sum(gh, axis=0)
         from ..ops.split import calculate_leaf_output
@@ -233,7 +238,8 @@ class DataParallelTreeLearner:
         small_id = jnp.where(smaller_is_left, leaf, new_leaf)
         small_mask = (leaf_of_row == small_id).astype(jnp.float32)
         hist_small = build_histogram(bins, state.gh * small_mask[:, None],
-                                     self.B, pallas_ok=False)
+                                     self.B, pallas_ok=False,
+                                     hist_impl=self._hist_impl)
         hist_small = jax.lax.with_sharding_constraint(
             hist_small, self.hist_sharding)
         hist_large = subtract_histogram(state.hists[leaf], hist_small)

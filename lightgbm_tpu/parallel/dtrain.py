@@ -79,16 +79,27 @@ def train(params: Dict, local_X: np.ndarray, local_y: np.ndarray,
     if obj_name == "binary" or obj_name.startswith("multiclass"):
         # per-class state (need_train, is_unbalance weights) derives
         # from LOCAL labels only; a shard missing a class would silently
-        # zero that class's gradients on this rank — fail loudly instead
-        # (reference analogue: pre-partitioned distributed data must
-        # keep label coverage per rank)
+        # zero that class's gradients on this rank. The check must be
+        # COLLECTIVE: a rank-local raise would leave the other ranks
+        # hanging in the first psum — so every rank gathers every
+        # rank's coverage bitmask and they all fail together.
+        expected = sorted(range(max(int(config.num_class), 2))
+                          if obj_name.startswith("multiclass")
+                          else (0, 1))
         present = set(np.unique(local_y.astype(np.int64)))
-        expected = (set(range(max(int(config.num_class), 2)))
-                    if obj_name.startswith("multiclass") else {0, 1})
-        if not expected <= present:
-            log.fatal("local shard is missing classes %s; distributed "
-                      "training needs every class on every shard"
-                      % sorted(expected - present))
+        mask = [1.0 if k in present else 0.0 for k in expected]
+        from jax.experimental import multihost_utils
+        all_masks = np.asarray(multihost_utils.process_allgather(
+            np.asarray(mask, dtype=np.float32).reshape(1, -1)))
+        all_masks = all_masks.reshape(jax.process_count(), -1)
+        bad = {r: [expected[k] for k in range(len(expected))
+                   if all_masks[r, k] == 0.0]
+               for r in range(all_masks.shape[0])
+               if (all_masks[r] == 0.0).any()}
+        if bad:
+            log.fatal("shards are missing classes (rank -> classes): "
+                      "%s; distributed training needs every class on "
+                      "every shard" % bad)
     objective.init(ds.metadata, n_local)
 
     K = max(int(objective.num_tree_per_iteration), 1)

@@ -81,7 +81,8 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
 
         def local(bins_shard, gh_shard, fmask):
             h = build_histogram(bins_shard, gh_shard, B,
-                                pallas_ok=False)  # local partial
+                                pallas_ok=False,
+                                hist_impl=self._hist_impl)  # local partial
             s = jnp.sum(gh_shard, axis=0)                   # local sums
             gains = _per_feature_best_gain(h, s[0], s[1], s[2], meta,
                                            params, fmask)

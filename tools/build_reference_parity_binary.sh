@@ -1,0 +1,121 @@
+#!/bin/bash
+# Build the reference LightGBM CLI from the read-only tree at
+# /root/reference into a /tmp scratch area, for the model-interchange /
+# accuracy-parity tests (tests/test_reference_parity.py).
+#
+# The image's reference checkout has empty external_libs/ submodules and
+# zero egress, so two tiny stand-in headers are generated (strtod-based
+# fast_double_parser, snprintf-based fmt covering the three format
+# strings LightGBM uses) and linear_tree_learner (needs Eigen) is
+# stubbed to fail loudly if requested.
+#
+# Usage: tools/build_reference_parity_binary.sh [/root/reference]
+# On success prints the binary path; export it as
+#   LGBM_TPU_REFERENCE_BIN=<path> python -m pytest tests/test_reference_parity.py
+set -euo pipefail
+
+SRC=${1:-/root/reference}
+WORK=/tmp/refsrc
+BUILD=/tmp/refbuild
+
+if [ -x "$WORK/lightgbm" ]; then
+  echo "$WORK/lightgbm"
+  exit 0
+fi
+
+rm -rf "$WORK" "$BUILD"
+cp -r "$SRC" "$WORK"
+chmod -R u+w "$WORK"
+
+mkdir -p "$WORK/external_libs/fast_double_parser/include" \
+         "$WORK/external_libs/fmt/include/fmt"
+
+cat > "$WORK/external_libs/fast_double_parser/include/fast_double_parser.h" <<'EOF'
+#pragma once
+#include <cstdlib>
+namespace fast_double_parser {
+inline const char* parse_number(const char* p, double* out) {
+  char* end = nullptr;
+  double v = std::strtod(p, &end);
+  if (end == p) return nullptr;
+  *out = v;
+  return end;
+}
+}  // namespace fast_double_parser
+EOF
+
+cat > "$WORK/external_libs/fmt/include/fmt/format.h" <<'EOF'
+#pragma once
+#include <cstdio>
+#include <cstring>
+#include <type_traits>
+namespace fmt {
+struct format_to_n_result { size_t size; };
+namespace detail {
+template <typename T>
+inline int write_value(char* buf, size_t n, const char*, T value,
+                       std::true_type) {
+  if (std::is_signed<T>::value)
+    return std::snprintf(buf, n, "%lld", static_cast<long long>(value));
+  return std::snprintf(buf, n, "%llu",
+                       static_cast<unsigned long long>(value));
+}
+template <typename T>
+inline int write_value(char* buf, size_t n, const char* spec, T value,
+                       std::false_type) {
+  double v = static_cast<double>(value);
+  if (std::strcmp(spec, "{:g}") == 0)
+    return std::snprintf(buf, n, "%g", v);
+  return std::snprintf(buf, n, "%.17g", v);
+}
+}  // namespace detail
+template <typename T>
+inline format_to_n_result format_to_n(char* buf, size_t n,
+                                      const char* spec, T value) {
+  int w = detail::write_value(
+      buf, n, spec, value,
+      std::integral_constant<bool, std::is_integral<T>::value>{});
+  return format_to_n_result{w < 0 ? n : static_cast<size_t>(w)};
+}
+}  // namespace fmt
+EOF
+
+python3 - "$WORK" <<'EOF'
+import sys
+work = sys.argv[1]
+p = work + "/src/treelearner/linear_tree_learner.cpp"
+open(p, "w").write('''// Parity-build stub: Eigen submodule unavailable; linear_tree fails
+// loudly if requested.
+#include "linear_tree_learner.h"
+#include <LightGBM/utils/log.h>
+namespace LightGBM {
+#define LGBM_STUB Log::Fatal("linear_tree unavailable in parity build")
+void LinearTreeLearner::Init(const Dataset* d, bool h) {
+  SerialTreeLearner::Init(d, h); LGBM_STUB; }
+void LinearTreeLearner::InitLinear(const Dataset*, const int) { LGBM_STUB; }
+Tree* LinearTreeLearner::Train(const score_t*, const score_t*, bool) {
+  LGBM_STUB; return nullptr; }
+void LinearTreeLearner::GetLeafMap(Tree*) const { LGBM_STUB; }
+template <bool HAS_NAN>
+void LinearTreeLearner::CalculateLinear(Tree*, bool, const score_t*,
+                                        const score_t*, bool) const {
+  LGBM_STUB; }
+template void LinearTreeLearner::CalculateLinear<true>(
+    Tree*, bool, const score_t*, const score_t*, bool) const;
+template void LinearTreeLearner::CalculateLinear<false>(
+    Tree*, bool, const score_t*, const score_t*, bool) const;
+Tree* LinearTreeLearner::FitByExistingTree(const Tree*, const score_t*,
+                                           const score_t*) const {
+  LGBM_STUB; return nullptr; }
+Tree* LinearTreeLearner::FitByExistingTree(
+    const Tree*, const std::vector<int>&, const score_t*,
+    const score_t*) const { LGBM_STUB; return nullptr; }
+}  // namespace LightGBM
+''')
+EOF
+
+mkdir -p "$BUILD"
+cd "$BUILD"
+cmake "$WORK" -DCMAKE_BUILD_TYPE=Release -DUSE_OPENMP=ON > cmake.log 2>&1
+make -j"$(nproc)" lightgbm > make.log 2>&1
+echo "$WORK/lightgbm"
