@@ -15,5 +15,9 @@ def refresh_learner_params(learner, config) -> None:
     if hasattr(learner, "_step_cache"):
         learner._step_cache.clear()
     if hasattr(learner, "_root_impl"):
-        import jax
-        learner._root_fn = jax.jit(learner._root_impl)
+        # mesh learners: the per-instance jits bake params/max_depth as
+        # constants — drop them; train()/the adapters rebuild lazily
+        for attr in ("_root_fn", "_tree_fn", "_step_fn", "_cegb_root_fn",
+                     "_mono_step_fn"):
+            if hasattr(learner, attr):
+                setattr(learner, attr, None)

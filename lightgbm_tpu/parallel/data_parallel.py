@@ -14,17 +14,36 @@ reduces over the sharded row axis — XLA inserts the cross-device psum
 its two collectives. The row partition update is a purely local sharded
 elementwise op, like the reference's per-rank ``DataPartition::Split``.
 
-Differences from the single-chip learner (treelearner/serial.py): the
-smaller-child row *compaction* (``jnp.nonzero``) is replaced by a masked
-full-length histogram pass — compaction is a global reshuffle that would
-force cross-device gathers, while a mask rides the existing sharding. The
-histogram-subtraction trick still halves the work: only the smaller child
-is histogrammed, the sibling comes from parent − smaller.
+Two departures from the single-chip learner (treelearner/serial.py):
+
+- the smaller-child row *compaction* (``jnp.nonzero``) is replaced by a
+  masked full-length histogram pass — compaction is a global reshuffle
+  that would force cross-device gathers, while a mask rides the existing
+  sharding. The histogram-subtraction trick still halves the work: only
+  the smaller child is histogrammed, the sibling comes from
+  parent − smaller.
+- the whole tree grows in ONE device dispatch: a ``lax.while_loop``
+  argmaxes the next leaf, applies the split, and scans both children,
+  writing each winning split into a [L-1] record buffer that the host
+  reads back once per tree. (The reference syncs rank↔rank per split;
+  a per-split host round-trip through a TPU tunnel costs ~27 ms, which
+  at 255 leaves would dominate training — measured round 3.) Because
+  there is no data-dependent gather size, the loop needs no host input
+  at all, unlike the serial learner's bucketed batching. Features whose
+  per-split host state steers the scan (CEGB penalties, intermediate
+  monotone bounds, per-node feature masks) fall back to a stepwise
+  host loop, exactly like the serial learner — via the shared drivers
+  in treelearner/capabilities.py.
+
+EFB stays *bundled* across the mesh (reference: bundles are built before
+ReduceScatter, src/io/dataset.cpp:107 + data_parallel_tree_learner.cpp:185):
+the sharded [N, G] bundle matrix is histogrammed locally, the [G, Bg, 4]
+bundle histogram crosses devices (comm O(G·Bg), not O(F·B)), and
+``unpack_bundle_histogram`` runs on the replicated side.
 """
 from __future__ import annotations
 
-from functools import partial
-from typing import NamedTuple, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,12 +52,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..io.dataset import BinnedDataset
 from ..models.tree import Tree
-from ..ops.histogram import build_histogram, subtract_histogram
-from ..ops.split import FeatureMeta, SplitParams, find_best_split
-from ..treelearner.serial import (GrowState, SplitRecord, _go_left_by_bin,
-                                  _record_at, _store_info, _NEG_INF,
-                                  apply_split_record, make_root_state,
-                                  record_is_valid)
+from ..ops.histogram import (build_histogram, subtract_histogram,
+                             unpack_bundle_histogram)
+from ..ops.split import (FeatureMeta, SplitParams, calculate_leaf_output,
+                         find_best_split)
+from ..treelearner.capabilities import (CapabilityMixin, train_cegb,
+                                        train_monotone, train_stepwise)
+from ..treelearner.serial import (GrowState, SplitRecord, _cegb_penalty,
+                                  _empty_records, _finish_split,
+                                  _go_left_by_bin, _maybe_rand_bins,
+                                  _partition_col, _record_at, _store_info,
+                                  apply_split_record, build_bundle_tables,
+                                  make_root_state, record_is_valid)
 from ..utils import log
 
 
@@ -51,53 +76,67 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
-class DataParallelTreeLearner:
+class DataParallelTreeLearner(CapabilityMixin):
     """Leaf-wise grower over row-sharded binned data.
 
-    Per split step (one SPMD dispatch):
-      partition update (local) -> masked histogram of the smaller child
-      (local partials + XLA-inserted psum) -> sibling by subtraction ->
-      replicated best-split scan -> argmax over leaves.
+    One device dispatch grows the whole tree:
+      while splits remain: argmax over leaf gains -> partition update
+      (local) -> masked histogram of the smaller child (local partials +
+      XLA-inserted psum) -> sibling by subtraction -> replicated
+      best-split scan -> record written to the read-back buffer.
     """
+
+    # feature-/voting-parallel subclasses unbundle instead (their comm
+    # patterns don't reduce over the full [F, B] histogram)
+    _supports_bundles = True
 
     def __init__(self, config, dataset: BinnedDataset, mesh: Mesh,
                  axis: str = "data"):
-        bins_host_full = self._init_mesh_common(config, dataset, mesh,
-                                                axis)
-        N, F = bins_host_full.shape
-        if F == 0:
+        cols_host = self._init_mesh_common(config, dataset, mesh, axis)
+        N, C = cols_host.shape
+        if self.F == 0:
             log.fatal("Cannot train without features")
-        self.N, self.F = N, F
+        self.N = N
         n_dev = mesh.devices.size
         # pad rows to a devices multiple; pad rows carry leaf -1 / gh 0
         self.R = -(-N // n_dev) * n_dev
-        pad = np.zeros((self.R - N, F), dtype=bins_host_full.dtype)
-        bins_host = np.concatenate([bins_host_full, pad], axis=0)
+        pad = np.zeros((self.R - N, C), dtype=cols_host.dtype)
+        bins_host = np.concatenate([cols_host, pad], axis=0)
         self.bins = jax.device_put(
             bins_host, NamedSharding(mesh, P(self.axis, None)))
+        self._init_cegb(config)
+        self._init_monotone(config)
 
     def _init_mesh_common(self, config, dataset: BinnedDataset,
                           mesh: Mesh, axis: str):
         """Shared mesh-learner setup (also used by the multi-process
-        DistributedDataParallelLearner); returns the per-feature host bin
-        matrix (unbundled if the dataset carries EFB bundles)."""
+        DistributedDataParallelLearner); returns the host bin-column
+        matrix — the EFB bundle matrix when bundled, per-feature
+        otherwise."""
         self.config = config
         self.dataset = dataset
         self.mesh = mesh
         self.axis = axis
-        if dataset.bundle is not None:
-            # EFB routing is implemented in the serial learner only; the
-            # mesh learners unbundle to per-feature columns (memory cost,
-            # same semantics)
-            log.warning("mesh-parallel learners run EFB-bundled datasets "
-                        "unbundled")
-            bins_host_full = dataset.feature_bins()
+        self.F = dataset.num_features
+        self.Fp = self.F  # masks/penalty vectors carry no padding here
+        self._bundled = (dataset.bundle is not None
+                         and self._supports_bundles)
+        if dataset.bundle is not None and not self._bundled:
+            cols_host = dataset.feature_bins()
         else:
-            bins_host_full = dataset.bins
+            cols_host = dataset.bins
         # power-of-two histogram width (see SerialTreeLearner: canonical
         # shapes share compiled variants across datasets)
         from ..utils import next_pow2
         self.B = next_pow2(max(int(dataset.max_num_bin), 2))
+        if self._bundled:
+            self.Bg = next_pow2(max(dataset.bundle.num_bundled_bins, 2))
+            self._btab = build_bundle_tables(
+                dataset, self.F, dataset.bundle.num_groups, self.B,
+                self.Bg)
+        else:
+            self.Bg = 0
+            self._btab = jnp.int32(0)
         self.L = int(config.num_leaves)
         self.max_depth = int(config.max_depth)
         self._hist_slots = self.L
@@ -120,28 +159,30 @@ class DataParallelTreeLearner:
             bool(getattr(config, "tpu_use_f64_hist", False)))
         self._has_cat = bool(
             np.asarray(self.meta.is_categorical).any())
+        self._extra_trees = bool(config.extra_trees)
+        self._extra_seed = int(config.extra_seed)
+        self._tree_idx = 0
+        self._resolve_constraints()
+        self._forced = None
+        if config.forcedsplits_filename:
+            log.warning("forcedsplits_filename is only implemented in "
+                        "the serial (single-chip) learner; IGNORED by "
+                        "mesh-parallel learners")
         self._root_fn = None
+        self._tree_fn = None
         self._step_fn = None
-        if getattr(config, "extra_trees", False):
-            log.warning("extra_trees is only implemented in the serial "
-                        "(single-chip) learner; the mesh-parallel learners "
-                        "run full greedy threshold scans")
-        # serial-learner-only features: warn LOUDLY instead of silently
-        # ignoring (these knobs would otherwise corrupt experiments)
-        if (config.cegb_tradeoff < 1.0 or config.cegb_penalty_split > 0.0
-                or config.cegb_penalty_feature_coupled
-                or config.cegb_penalty_feature_lazy):
-            log.warning("CEGB (cegb_*) is only implemented in the serial "
-                        "learner; IGNORED by mesh-parallel learners")
-        if config.monotone_penalty != 0.0:
-            log.warning("monotone_penalty is only implemented in the "
-                        "serial learner; IGNORED here")
-        if (config.monotone_constraints_method != "basic"
-                and dataset.monotone_constraints is not None):
-            log.warning("monotone_constraints_method=%s degrades to "
-                        "'basic' in mesh-parallel learners"
-                        % config.monotone_constraints_method)
-        return bins_host_full
+        self._cegb_root_fn = None
+        self._mono_step_fn = None
+        return cols_host
+
+    def _make_cegb_fetched(self, rows: int) -> jnp.ndarray:
+        """Row-sharded lazy-fetched matrix (global-view creation works
+        across processes for the multi-process subclass too)."""
+        sh = (NamedSharding(self.mesh, P(self.axis, None)) if rows > 1
+              else self.rep_sharding)
+        return jax.jit(lambda: jnp.zeros((rows, self.Fp),
+                                         dtype=jnp.float32),
+                       out_shardings=sh)()
 
     # ------------------------------------------------------------------
     def _sample_features(self) -> jnp.ndarray:
@@ -151,6 +192,13 @@ class DataParallelTreeLearner:
             k = max(1, int(round(self.F * ff)))
             mask[:] = False
             mask[self._ff_rng.choice(self.F, k, replace=False)] = True
+        if self._constraint_groups is not None:
+            # root scan may only use features inside some constraint
+            # group (reference: ColSampler::SetUsedFeatureByNode)
+            allowed = np.zeros(self.F, dtype=bool)
+            for grp in self._constraint_groups:
+                allowed[list(grp)] = True
+            mask &= allowed
         return jax.device_put(jnp.asarray(mask), self.rep_sharding)
 
     # ------------------------------------------------------------------
@@ -164,71 +212,86 @@ class DataParallelTreeLearner:
         return jax.lax.with_sharding_constraint(leaf_of_row,
                                                 self.row_sharding)
 
-    def _root_impl(self, bins, gh, feature_mask, children_allowed):
-        hist = build_histogram(bins, gh, self.B, pallas_ok=False,
-                               hist_impl=self._hist_impl)
-        hist = jax.lax.with_sharding_constraint(hist, self.hist_sharding)
+    def _mesh_hist(self, bins, gh, totals):
+        """Globally-summed per-feature [F, B, 4] histogram. Bundled:
+        only the [G, Bg, 4] bundle histogram crosses devices, then the
+        per-feature unpack runs replicated (``totals`` reconstructs the
+        zero-bin rows of bundled features, io/efb.py)."""
+        if not self._bundled:
+            h = build_histogram(bins, gh, self.B, pallas_ok=False,
+                                hist_impl=self._hist_impl)
+            return jax.lax.with_sharding_constraint(h, self.hist_sharding)
+        bh = build_histogram(bins, gh, self.Bg, pallas_ok=False,
+                             hist_impl=self._hist_impl)
+        bh = jax.lax.with_sharding_constraint(bh, self.rep_sharding)
+        return unpack_bundle_histogram(bh, self._btab.gidx_g,
+                                       self._btab.gidx_b,
+                                       self._btab.zero_fix,
+                                       self.meta.zero_bin, totals)
+
+    def _root_impl_opts(self, bins, gh, feature_mask, rand_seed,
+                        extra_trees: bool):
         sums = jnp.sum(gh, axis=0)
-        from ..ops.split import calculate_leaf_output
+        hist = self._mesh_hist(bins, gh, sums)
         parent_out = calculate_leaf_output(sums[0], sums[1], self.params)
-        info = find_best_split(hist, sums[0], sums[1], sums[2], sums[3],
-                               self.meta, self.params, feature_mask,
-                               parent_output=parent_out,
-                               has_categorical=self._has_cat)
+        info = find_best_split(
+            hist, sums[0], sums[1], sums[2], sums[3], self.meta,
+            self.params, feature_mask, parent_output=parent_out,
+            rand_bins=_maybe_rand_bins(extra_trees, rand_seed, 0,
+                                       self.meta, self.params),
+            leaf_depth=jnp.int32(0), has_categorical=self._has_cat)
         leaf_of_row = self._initial_partition(gh)
         state = make_root_state(gh, hist, leaf_of_row, info, self.L,
-                                self.F, self.B, children_allowed,
+                                self.F, self.B, self._splittable(0),
                                 hist_slots=self._hist_slots)
         return state, _record_at(state, 0)
 
-    def _step_impl(self, bins, state: GrowState, leaf, new_leaf,
-                   children_allowed, feature_mask):
-        meta, params, B = self.meta, self.params, self.B
-        f = state.feature[leaf]
-        tbin = state.threshold_bin[leaf]
-        dl = state.default_left[leaf]
-        col = jnp.take(bins, f, axis=1).astype(jnp.int32)
-        gl = _go_left_by_bin(col, tbin, dl, meta.missing_type[f],
-                             meta.num_bin[f] - 1, meta.zero_bin[f],
-                             state.is_categorical[leaf],
-                             state.cat_mask[leaf])
+    def _root_impl(self, bins, gh, feature_mask, rand_seed):
+        return self._root_impl_opts(bins, gh, feature_mask, rand_seed,
+                                    self._extra_trees)
+
+    def _mesh_split_body(self, bins, state: GrowState, rec: SplitRecord,
+                         leaf, new_leaf, valid, mask_left, mask_right,
+                         rand_seed=0, extra_trees=None, pen_left=None,
+                         pen_right=None):
+        """Apply one chosen split and scan both children. ``valid``
+        guards every state write (loop steps after the no-more-splits
+        point must leave state untouched). The tail — depth gating, the
+        two child scans, the candidate stores — is the serial learner's
+        _finish_split; only the child-histogram computation differs."""
+        meta = self.meta
+        f = jnp.maximum(rec.feature, 0)
+        col = _partition_col(bins, f, meta, self._btab, self._bundled)
+        gl = _go_left_by_bin(col, rec.threshold_bin, rec.default_left,
+                             meta.missing_type[f], meta.num_bin[f] - 1,
+                             meta.zero_bin[f], rec.is_categorical,
+                             rec.cat_mask)
         on_leaf = state.leaf_of_row == leaf
-        leaf_of_row = jnp.where(on_leaf & ~gl, new_leaf, state.leaf_of_row)
+        leaf_of_row = jnp.where(valid & on_leaf & ~gl, new_leaf,
+                                state.leaf_of_row)
         leaf_of_row = jax.lax.with_sharding_constraint(
             leaf_of_row, self.row_sharding)
 
-        ltc, rtc = (state.left_total_count[leaf],
-                    state.right_total_count[leaf])
-        smaller_is_left = ltc <= rtc
+        smaller_is_left = rec.left_total_count <= rec.right_total_count
         (hist_left, hist_right, mask_left,
          mask_right) = self._children_histograms(
-            bins, state, leaf, new_leaf, leaf_of_row, smaller_is_left,
-            feature_mask)
+            bins, state, rec, leaf, new_leaf, leaf_of_row,
+            smaller_is_left, mask_left, mask_right)
         hists = self._update_hist_store(state, leaf, new_leaf, hist_left,
-                                        hist_right)
-
-        lc, rc = state.left_count[leaf], state.right_count[leaf]
-        left_info = find_best_split(
-            hist_left, state.left_sum_grad[leaf],
-            state.left_sum_hess[leaf], lc, ltc, meta, params, mask_left,
-            state.cand_left_min[leaf], state.cand_left_max[leaf],
-            parent_output=state.left_output[leaf],
-            has_categorical=self._has_cat)
-        right_info = find_best_split(
-            hist_right, state.right_sum_grad[leaf],
-            state.right_sum_hess[leaf], rc, rtc, meta, params, mask_right,
-            state.cand_right_min[leaf], state.cand_right_max[leaf],
-            parent_output=state.right_output[leaf],
-            has_categorical=self._has_cat)
-
+                                        hist_right, valid)
         state = state._replace(leaf_of_row=leaf_of_row, hists=hists)
-        state = _store_info(state, leaf, left_info, children_allowed)
-        state = _store_info(state, new_leaf, right_info, children_allowed)
-        best = jnp.argmax(state.gain).astype(jnp.int32)
-        return state, _record_at(state, best)
+        return _finish_split(
+            state, rec, leaf, new_leaf, valid, hist_left, hist_right,
+            mask_left, mask_right, meta, self.params,
+            max_depth=self.max_depth,
+            extra_trees=(self._extra_trees if extra_trees is None
+                         else extra_trees),
+            has_cat=self._has_cat, rand_seed=rand_seed,
+            pen_left=pen_left, pen_right=pen_right)
 
-    def _children_histograms(self, bins, state, leaf, new_leaf,
-                             leaf_of_row, smaller_is_left, feature_mask):
+    def _children_histograms(self, bins, state, rec, leaf, new_leaf,
+                             leaf_of_row, smaller_is_left, mask_left,
+                             mask_right):
         """Cross-device-summed child histograms + the per-child scan
         masks. Base learner: masked histogram of the smaller child over
         the full sharded row space (the analogue of the reference ranks
@@ -237,28 +300,240 @@ class DataParallelTreeLearner:
         Voting-parallel overrides this with the reduced-comm vote."""
         small_id = jnp.where(smaller_is_left, leaf, new_leaf)
         small_mask = (leaf_of_row == small_id).astype(jnp.float32)
-        hist_small = build_histogram(bins, state.gh * small_mask[:, None],
-                                     self.B, pallas_ok=False,
-                                     hist_impl=self._hist_impl)
-        hist_small = jax.lax.with_sharding_constraint(
-            hist_small, self.hist_sharding)
+        small_totals = jnp.stack([
+            jnp.where(smaller_is_left, rec.left_sum_grad,
+                      rec.right_sum_grad),
+            jnp.where(smaller_is_left, rec.left_sum_hess,
+                      rec.right_sum_hess),
+            jnp.where(smaller_is_left, rec.left_count, rec.right_count),
+            jnp.where(smaller_is_left, rec.left_total_count,
+                      rec.right_total_count)])
+        hist_small = self._mesh_hist(bins, state.gh * small_mask[:, None],
+                                     small_totals)
         hist_large = subtract_histogram(state.hists[leaf], hist_small)
         hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
         hist_right = jnp.where(smaller_is_left, hist_large, hist_small)
-        return hist_left, hist_right, feature_mask, feature_mask
+        return hist_left, hist_right, mask_left, mask_right
 
     def _update_hist_store(self, state, leaf, new_leaf, hist_left,
-                           hist_right):
+                           hist_right, valid):
         """Per-leaf histogram pool update (the subtraction trick reads
         these; the voting learner overrides this to skip the store)."""
-        return state.hists.at[leaf].set(hist_left) \
-                          .at[new_leaf].set(hist_right)
+        return state.hists \
+            .at[leaf].set(jnp.where(valid, hist_left,
+                                    state.hists[leaf])) \
+            .at[new_leaf].set(jnp.where(valid, hist_right,
+                                        state.hists[new_leaf]))
+
+    # ------------------------------------------------------------------
+    def _tree_impl(self, bins, state: GrowState, feature_mask, rand_seed):
+        """Grow the whole tree in one dispatch: while splits remain, the
+        device argmaxes the next leaf (the argmax the reference reaches
+        via SyncUpGlobalBestSplit), applies it, and appends the record.
+        Exits as soon as no positive-gain candidate is left, so a short
+        tree costs no wasted iterations."""
+        kb = self.L - 1
+
+        def cond(carry):
+            i, _, _, cont = carry
+            return cont & (i < kb)
+
+        def body(carry):
+            i, state, recs, _ = carry
+            best = jnp.argmax(state.gain).astype(jnp.int32)
+            rec = _record_at(state, best)
+            valid = rec_valid(rec)
+            recs = jax.tree_util.tree_map(
+                lambda buf, v: buf.at[i].set(v), recs, rec)
+            new_leaf = (i + 1).astype(jnp.int32)
+            state = self._mesh_split_body(bins, state, rec, best,
+                                          new_leaf, valid, feature_mask,
+                                          feature_mask,
+                                          rand_seed=rand_seed)
+            return i + 1, state, recs, valid
+
+        carry = (jnp.int32(0), state, _empty_records(kb, self.B),
+                 jnp.asarray(True))
+        _, state, recs, _ = jax.lax.while_loop(cond, body, carry)
+        return state, recs
+
+    def _step_impl(self, bins, state: GrowState, leaf, new_leaf,
+                   mask_left, mask_right, rand_seed):
+        """Single split step with a host-chosen leaf — the stepwise path
+        used when per-split host state steers the scan (per-node feature
+        masks; CEGB and intermediate monotone have their own variants)."""
+        rec = _record_at(state, leaf)
+        valid = rec_valid(rec)
+        state = self._mesh_split_body(bins, state, rec, leaf, new_leaf,
+                                      valid, mask_left, mask_right,
+                                      rand_seed=rand_seed)
+        best = jnp.argmax(state.gain).astype(jnp.int32)
+        return state, _record_at(state, best)
+
+    # --- CEGB (reference: cost_effective_gradient_boosting.hpp) -------
+    def _cegb_root_impl(self, bins, gh, feature_mask, used, fetched):
+        sums = jnp.sum(gh, axis=0)
+        hist = self._mesh_hist(bins, gh, sums)
+        parent_out = calculate_leaf_output(sums[0], sums[1], self.params)
+        leaf_of_row = self._initial_partition(gh)
+        if self._cegb_has_lazy:
+            in_rows = (leaf_of_row >= 0).astype(jnp.float32)
+            unfetched = jnp.einsum("r,rf->f", in_rows, 1.0 - fetched)
+            lazy = self._cegb_lazy
+        else:
+            unfetched, lazy = None, None
+        pen = _cegb_penalty(self.params, sums[3], used,
+                            self._cegb_coupled, unfetched, lazy)
+        info = find_best_split(
+            hist, sums[0], sums[1], sums[2], sums[3], self.meta,
+            self.params, feature_mask, parent_output=parent_out,
+            gain_penalty=pen, has_categorical=self._has_cat)
+        state = make_root_state(gh, hist, leaf_of_row, info, self.L,
+                                self.F, self.B, self._splittable(0),
+                                hist_slots=self._hist_slots)
+        return state, _record_at(state, 0)
+
+    def _cegb_step_impl(self, bins, state, leaf, new_leaf, feature_mask,
+                        used, fetched):
+        """Mesh CEGB step (mirrors serial.py _cegb_step_fn_cached; the
+        unfetched row sums reduce over the sharded row axis — XLA
+        inserts the psum)."""
+        rec = _record_at(state, leaf)
+        f = jnp.maximum(rec.feature, 0)
+        used2 = used.at[f].set(True)
+        on_leaf = state.leaf_of_row == leaf
+        if self._cegb_has_lazy:
+            fetched2 = jnp.maximum(
+                fetched,
+                on_leaf.astype(fetched.dtype)[:, None]
+                * jax.nn.one_hot(f, fetched.shape[1],
+                                 dtype=fetched.dtype))
+            col = _partition_col(bins, f, self.meta, self._btab,
+                                 self._bundled)
+            gl = _go_left_by_bin(col, rec.threshold_bin, rec.default_left,
+                                 self.meta.missing_type[f],
+                                 self.meta.num_bin[f] - 1,
+                                 self.meta.zero_bin[f],
+                                 rec.is_categorical, rec.cat_mask)
+            unf = 1.0 - fetched2
+            unf_left = jnp.einsum(
+                "r,rf->f", (on_leaf & gl).astype(jnp.float32), unf)
+            unf_right = jnp.einsum(
+                "r,rf->f", (on_leaf & ~gl).astype(jnp.float32), unf)
+            lazy = self._cegb_lazy
+        else:
+            fetched2 = fetched
+            unf_left = unf_right = lazy = None
+        pen_l = _cegb_penalty(self.params, rec.left_total_count, used2,
+                              self._cegb_coupled, unf_left, lazy)
+        pen_r = _cegb_penalty(self.params, rec.right_total_count, used2,
+                              self._cegb_coupled, unf_right, lazy)
+        valid = rec_valid(rec)
+        state = self._mesh_split_body(bins, state, rec, leaf, new_leaf,
+                                      valid, feature_mask, feature_mask,
+                                      extra_trees=False, pen_left=pen_l,
+                                      pen_right=pen_r)
+        best = jnp.argmax(state.gain).astype(jnp.int32)
+        return state, _record_at(state, best), used2, fetched2
+
+    # --- intermediate monotone (reference: monotone_constraints.hpp) --
+    def _mono_step_impl(self, bins, state, leaf, new_leaf, feature_mask,
+                        lmin, lmax, rmin, rmax):
+        """The children's output bounds come from the host tracker
+        (sibling-output based, monotone_constraints.hpp:543) instead of
+        the mid-point rule baked into the stored candidate."""
+        state = state._replace(
+            cand_left_min=state.cand_left_min.at[leaf].set(lmin),
+            cand_left_max=state.cand_left_max.at[leaf].set(lmax),
+            cand_right_min=state.cand_right_min.at[leaf].set(rmin),
+            cand_right_max=state.cand_right_max.at[leaf].set(rmax))
+        rec = _record_at(state, leaf)
+        valid = rec_valid(rec)
+        state = self._mesh_split_body(bins, state, rec, leaf, new_leaf,
+                                      valid, feature_mask, feature_mask,
+                                      extra_trees=False)
+        best = jnp.argmax(state.gain).astype(jnp.int32)
+        return state, _record_at(state, best), state.gain
+
+    def _rescan_impl(self, state, leaf, sg, sh, c, tc, vmin, vmax, depth,
+                     allowed, feature_mask):
+        """Recompute one leaf's candidate from its stored (replicated)
+        histogram under tightened bounds (reference:
+        SerialTreeLearner::RecomputeBestSplitForLeaf,
+        serial_tree_learner.cpp:800)."""
+        hist = state.hists[leaf]
+        own = calculate_leaf_output(sg, sh, self.params)
+        parent_out = jnp.where(self.params.path_smooth > 1e-10, own, 0.0)
+        info = find_best_split(hist, sg, sh, c, tc, self.meta,
+                               self.params, feature_mask, vmin, vmax,
+                               parent_output=parent_out,
+                               leaf_depth=depth,
+                               has_categorical=self._has_cat)
+        state = _store_info(state, leaf, info, allowed)
+        best = jnp.argmax(state.gain).astype(jnp.int32)
+        return state, _record_at(state, best), state.gain
+
+    # --- adapter methods for the shared capability drivers ------------
+    def _cegb_root(self, gh, feature_mask):
+        if self._cegb_root_fn is None:
+            self._cegb_root_fn = jax.jit(self._cegb_root_impl)
+            self._cegb_step_fn = jax.jit(self._cegb_step_impl,
+                                         donate_argnums=(1,))
+        return self._cegb_root_fn(self.bins, gh, feature_mask,
+                                  self._cegb_used, self._cegb_fetched)
+
+    def _cegb_step(self, state, leaf, k, allowed, feature_mask, smaller):
+        state, rec, self._cegb_used, self._cegb_fetched = \
+            self._cegb_step_fn(self.bins, state, jnp.int32(leaf),
+                               jnp.int32(k), feature_mask,
+                               self._cegb_used, self._cegb_fetched)
+        return state, rec
+
+    def _mono_root(self, gh, feature_mask, rand_seed):
+        # the root scan must be greedy too, not just the step scans
+        # (extra_trees is ignored under intermediate monotone — serial
+        # learner contract, _mono_root in treelearner/serial.py)
+        if self._mono_root_fn is None:
+            self._mono_root_fn = jax.jit(
+                lambda b, g, f, r: self._root_impl_opts(b, g, f, r,
+                                                        False))
+        return self._mono_root_fn(self.bins, gh, feature_mask,
+                                  jnp.int32(rand_seed))
+
+    def _mono_step(self, state, leaf, k, allowed, feature_mask, bounds,
+                   smaller):
+        if self._mono_step_fn is None:
+            self._mono_step_fn = jax.jit(self._mono_step_impl,
+                                         donate_argnums=(1,))
+            self._rescan_fn = jax.jit(self._rescan_impl,
+                                      donate_argnums=(0,))
+        return self._mono_step_fn(
+            self.bins, state, jnp.int32(leaf), jnp.int32(k), feature_mask,
+            jnp.float32(bounds[0]), jnp.float32(bounds[1]),
+            jnp.float32(bounds[2]), jnp.float32(bounds[3]))
+
+    def _mono_rescan(self, state, leaf, sums, entry, depth, allowed,
+                     feature_mask):
+        sg, sh, c, tc = sums
+        return self._rescan_fn(
+            state, jnp.int32(leaf), jnp.float32(sg), jnp.float32(sh),
+            jnp.float32(c), jnp.float32(tc), jnp.float32(entry[0]),
+            jnp.float32(entry[1]), jnp.int32(depth), jnp.asarray(allowed),
+            feature_mask)
+
+    def _node_step(self, state, leaf, k, allowed, mask_left, mask_right,
+                   rand_seed, smaller):
+        if self._step_fn is None:
+            self._step_fn = jax.jit(self._step_impl, donate_argnums=(1,))
+        return self._step_fn(self.bins, state, jnp.int32(leaf),
+                             jnp.int32(k), mask_left, mask_right,
+                             jnp.int32(rand_seed))
 
     # ------------------------------------------------------------------
     def _ensure_compiled(self):
         if self._root_fn is None:
             self._root_fn = jax.jit(self._root_impl)
-            self._step_fn = jax.jit(self._step_impl, donate_argnums=(1,))
+            self._tree_fn = jax.jit(self._tree_impl, donate_argnums=(1,))
 
     def _splittable(self, depth: int) -> bool:
         return self.max_depth <= 0 or depth < self.max_depth
@@ -280,23 +555,35 @@ class DataParallelTreeLearner:
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
               bag: Optional[jnp.ndarray] = None) -> Tuple[Tree, jnp.ndarray]:
         """Grow one tree over the sharded dataset. Same contract as
-        SerialTreeLearner.train (treelearner/serial.py)."""
+        SerialTreeLearner.train (treelearner/serial.py). On the default
+        path there is exactly one host read-back per tree: the [L-1]
+        record buffer."""
         self._ensure_compiled()
         gh = self._make_gh(grad, hess, bag)
         feature_mask = self._sample_features()
 
         tree = Tree(self.L)
-        state, rec = self._root_fn(self.bins, gh, feature_mask,
-                                   self._splittable(0))
-        pending = jax.device_get(rec)
-        for k in range(1, self.L):
-            if not record_is_valid(pending):
+        self._tree_idx += 1
+        rand_seed = jnp.int32(
+            (self._extra_seed + 7919 * self._tree_idx) & 0x7FFFFFFF)
+        if self._cegb_enabled:
+            state = train_cegb(self, tree, gh, feature_mask)
+            return tree, self._finalize_partition(state.leaf_of_row)
+        if self._mono_tracker is not None:
+            state = train_monotone(self, tree, gh, feature_mask,
+                                   rand_seed)
+            return tree, self._finalize_partition(state.leaf_of_row)
+        state, rec = self._root_fn(self.bins, gh, feature_mask, rand_seed)
+        if self._needs_per_node_masks():
+            state = train_stepwise(self, tree, state, rec, feature_mask,
+                                   rand_seed)
+            return tree, self._finalize_partition(state.leaf_of_row)
+        state, recs = self._tree_fn(self.bins, state, feature_mask,
+                                    rand_seed)
+        recs_h = jax.device_get(recs)
+        for i in range(self.L - 1):
+            r = jax.tree_util.tree_map(lambda a: a[i], recs_h)
+            if not record_is_valid(r):
                 break
-            leaf = int(pending.leaf)
-            apply_split_record(tree, self.dataset, pending)
-            children_allowed = self._splittable(int(tree.leaf_depth[leaf]))
-            state, rec = self._step_fn(
-                self.bins, state, jnp.int32(leaf), jnp.int32(k),
-                jnp.asarray(children_allowed), feature_mask)
-            pending = jax.device_get(rec)
+            apply_split_record(tree, self.dataset, r)
         return tree, self._finalize_partition(state.leaf_of_row)

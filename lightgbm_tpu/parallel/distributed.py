@@ -117,15 +117,14 @@ class DistributedDataParallelLearner(DataParallelTreeLearner):
 
         bins_local = self._init_mesh_common(config, local_dataset, mesh,
                                             axis)
-        n_local, F = bins_local.shape
-        if F == 0:
+        n_local, C = bins_local.shape
+        if self.F == 0:
             log.fatal("Cannot train without features")
         n_proc = jax.process_count()
         dev_per_proc = len(mesh.devices.flatten()) // max(n_proc, 1)
         counts = np.asarray(multihost_utils.process_allgather(
             np.asarray([n_local], dtype=np.int64))).reshape(-1)
         self.N = int(counts.sum())
-        self.F = F
         # per-process padded block, equal across processes so the global
         # row axis splits evenly over devices
         block = -(-int(counts.max()) // max(dev_per_proc, 1)) \
@@ -134,10 +133,12 @@ class DistributedDataParallelLearner(DataParallelTreeLearner):
         self._block = block
         self._n_local = n_local
 
-        local_bins = np.zeros((block, F), dtype=bins_local.dtype)
+        local_bins = np.zeros((block, C), dtype=bins_local.dtype)
         local_bins[:n_local] = bins_local
         self.bins = jax.make_array_from_process_local_data(
             NamedSharding(mesh, P(self.axis, None)), local_bins)
+        self._init_cegb(config)
+        self._init_monotone(config)
 
     def _make_gh(self, grad, hess, bag) -> jnp.ndarray:
         """Local [n_local] numpy grad/hess shard → global padded sharded

@@ -13,19 +13,13 @@ splitting locally since all ranks hold all data).
 """
 from __future__ import annotations
 
-from typing import Optional, Tuple
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..io.dataset import BinnedDataset
-from ..models.tree import Tree
-from ..ops.histogram import build_histogram, subtract_histogram
-from ..ops.split import FeatureMeta, SplitParams, find_best_split
-from ..treelearner.serial import (GrowState, _go_left_by_bin, _record_at,
-                                  _store_info, _NEG_INF)
+from ..ops.split import FeatureMeta
 from .data_parallel import DataParallelTreeLearner
 
 
@@ -34,7 +28,13 @@ class FeatureParallelTreeLearner(DataParallelTreeLearner):
     sharded over features instead of rows. Rows are replicated (the
     reference's "all ranks hold all data"), so the partition update is
     fully local and the histogram needs no cross-device reduction at all —
-    only the best-split argmax crosses devices."""
+    only the best-split argmax crosses devices.
+
+    EFB bundles are unpacked here: features are the sharded axis, and
+    bundle columns would couple features across shards (the histogram
+    never crosses devices in this learner, so bundling buys no comm)."""
+
+    _supports_bundles = False
 
     def __init__(self, config, dataset: BinnedDataset, mesh: Mesh,
                  axis: str = "data"):
@@ -73,9 +73,20 @@ class FeatureParallelTreeLearner(DataParallelTreeLearner):
         )
         self.meta = jax.device_put(self.meta, self.rep_sharding)
         self.F = Fp
+        self.Fp = Fp
         # keep histograms feature-sharded; only the argmax crosses devices
         self.hist_sharding = NamedSharding(mesh, P(self.axis, None, None))
         self.gh_sharding = NamedSharding(mesh, P(None, None))  # replicated
+        # the base __init__ sized the CEGB/monotone vectors before the
+        # feature-axis repadding above — rebuild them at [Fp]
+        self._init_cegb(config)
+        self._init_monotone(config)
+
+    def _make_cegb_fetched(self, rows: int) -> jnp.ndarray:
+        # rows are replicated in this learner
+        return jax.jit(lambda: jnp.zeros((rows, self.Fp),
+                                         dtype=jnp.float32),
+                       out_shardings=self.rep_sharding)()
 
     def _sample_features(self) -> jnp.ndarray:
         mask = np.zeros(self.F_pad, dtype=bool)
@@ -87,12 +98,10 @@ class FeatureParallelTreeLearner(DataParallelTreeLearner):
             base[:] = False
             base[self._ff_rng.choice(real_f, k, replace=False)] = True
         mask[:real_f] = base
+        if self._constraint_groups is not None:
+            allowed = np.zeros(self.F_pad, dtype=bool)
+            for grp in self._constraint_groups:
+                allowed[list(grp)] = True
+            mask &= allowed
         return jax.device_put(jnp.asarray(mask), self.rep_sharding)
 
-    def _step_impl(self, bins, state, leaf, new_leaf, children_allowed,
-                   feature_mask):
-        # identical dataflow to the data-parallel step; the sharding of
-        # the bins argument (features) makes the histogram feature-sharded
-        # and the partition column-gather cross-device
-        return super()._step_impl(bins, state, leaf, new_leaf,
-                                  children_allowed, feature_mask)

@@ -60,7 +60,16 @@ def _per_feature_best_gain(hist, sum_grad, sum_hess, sum_count, meta,
 
 class VotingParallelTreeLearner(DataParallelTreeLearner):
     """Data-parallel learner whose cross-device histogram traffic is
-    restricted to per-leaf globally voted features."""
+    restricted to per-leaf globally voted features.
+
+    EFB bundles are unpacked here: votes are per-feature, and the voted
+    block slice already bounds the cross-device bytes below a bundle
+    histogram's O(G·B)."""
+
+    _supports_bundles = False
+    # no per-leaf histogram store → the intermediate monotone method's
+    # rescans are impossible; it degrades to basic (CapabilityMixin)
+    _supports_intermediate = False
 
     def __init__(self, config, dataset: BinnedDataset, mesh: Mesh,
                  axis: str = "data"):
@@ -105,19 +114,20 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
             in_specs=(P(axis, None), P(axis, None), P()),
             out_specs=(P(), P()))(bins, gh_masked, feature_mask)
 
-    def _children_histograms(self, bins, state, leaf, new_leaf,
-                             leaf_of_row, smaller_is_left, feature_mask):
+    def _children_histograms(self, bins, state, rec, leaf, new_leaf,
+                             leaf_of_row, smaller_is_left, mask_left,
+                             mask_right):
         left_id = leaf  # left child keeps the split leaf's id
         mask_l = (leaf_of_row == left_id).astype(jnp.float32)
         mask_r = (leaf_of_row == new_leaf).astype(jnp.float32)
         hist_left, voted_l = self._voted_reduced_histogram(
-            bins, state.gh * mask_l[:, None], feature_mask)
+            bins, state.gh * mask_l[:, None], mask_left)
         hist_right, voted_r = self._voted_reduced_histogram(
-            bins, state.gh * mask_r[:, None], feature_mask)
-        return (hist_left, hist_right, feature_mask & voted_l,
-                feature_mask & voted_r)
+            bins, state.gh * mask_r[:, None], mask_right)
+        return (hist_left, hist_right, mask_left & voted_l,
+                mask_right & voted_r)
 
     def _update_hist_store(self, state, leaf, new_leaf, hist_left,
-                           hist_right):
+                           hist_right, valid):
         # histograms are re-voted fresh per leaf; nothing reads the store
         return state.hists

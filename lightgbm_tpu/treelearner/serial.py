@@ -57,6 +57,8 @@ from ..ops.split import (FeatureMeta, SplitInfo, SplitParams,
                          calculate_leaf_output, find_best_split,
                          make_rand_bins)
 from ..utils import log, next_pow2 as _next_pow2
+from .capabilities import (CapabilityMixin, train_cegb, train_monotone,
+                           train_stepwise)
 
 _NEG_INF = -jnp.inf
 _MIN_BUCKET = 256
@@ -223,6 +225,15 @@ def record_is_valid(rec) -> bool:
             and float(rec.gain) > 0.0)
 
 
+def rec_valid(rec: SplitRecord):
+    """Device-side twin of record_is_valid — the two predicates MUST stay
+    in lockstep (the device suppresses state writes for invalid records,
+    the host stops applying them; divergence would desync the tree from
+    the partition)."""
+    return ((rec.feature >= 0) & jnp.isfinite(rec.gain)
+            & (rec.gain > 0.0))
+
+
 def apply_split_record(tree: Tree, dataset: BinnedDataset, rec) -> None:
     """Replay one device split record into the host Tree (reference:
     the Tree::Split call inside SerialTreeLearner::Split,
@@ -316,6 +327,32 @@ def _leaf_histogram(bins, gh, meta, btab, *, B: int, Bg: int,
                                    btab.zero_fix, meta.zero_bin, totals)
 
 
+def build_bundle_tables(dataset: BinnedDataset, Fp: int, Gp: int,
+                        B: int, Bg: int) -> BundleTables:
+    """Device EFB tables from the dataset's BundleLayout, padded to
+    ``Fp`` features / ``Gp`` bundle columns (shared by the serial and
+    mesh-parallel learners)."""
+    lay = dataset.bundle
+    F = dataset.num_features
+    G = lay.num_groups
+    member = np.full((Gp, Bg), -1, dtype=np.int32)
+    member[:G, :lay.member.shape[1]] = lay.member
+    unmap = np.zeros((Gp, Bg), dtype=np.int32)
+    unmap[:G, :lay.unmap.shape[1]] = lay.unmap
+    group_of = np.zeros(Fp, dtype=np.int32)
+    group_of[:F] = lay.group_of
+    gidx_g = np.full((Fp, B), -1, dtype=np.int32)
+    gidx_b = np.zeros((Fp, B), dtype=np.int32)
+    gidx_g[:F, :lay.gidx_g.shape[1]] = lay.gidx_g
+    gidx_b[:F, :lay.gidx_b.shape[1]] = lay.gidx_b
+    zero_fix = np.zeros(Fp, dtype=bool)
+    zero_fix[:F] = lay.needs_zero_fix
+    return BundleTables(
+        group_of=jnp.asarray(group_of), member=jnp.asarray(member),
+        unmap=jnp.asarray(unmap), gidx_g=jnp.asarray(gidx_g),
+        gidx_b=jnp.asarray(gidx_b), zero_fix=jnp.asarray(zero_fix))
+
+
 def _partition_col(bins, f, meta, btab, bundled: bool):
     """The split feature's ORIGINAL bin value per row (unbundling via the
     member/unmap LUTs when bundled; identity otherwise)."""
@@ -325,6 +362,53 @@ def _partition_col(bins, f, meta, btab, bundled: bool):
     raw = jnp.take(bins, g, axis=1).astype(jnp.int32)
     owner = btab.member[g][raw]
     return jnp.where(owner == f, btab.unmap[g][raw], meta.zero_bin[f])
+
+
+def _finish_split(state: GrowState, rec: SplitRecord, leaf, new_leaf,
+                  valid, hist_left, hist_right, mask_left, mask_right,
+                  meta, params, *, max_depth: int, extra_trees: bool,
+                  has_cat: bool, rand_seed=0, pen_left=None,
+                  pen_right=None, children_allowed=None) -> GrowState:
+    """Depth gating + both children's best-split scans + candidate
+    stores — the split-step tail shared verbatim by the serial and
+    mesh-parallel learners (only the child-histogram computation
+    differs). ``children_allowed`` None means: derive from the
+    device-side leaf_depth against the static max_depth."""
+    child_depth = state.leaf_depth[leaf] + 1
+    leaf_depth = state.leaf_depth \
+        .at[leaf].set(jnp.where(valid, child_depth,
+                                state.leaf_depth[leaf])) \
+        .at[new_leaf].set(jnp.where(valid, child_depth,
+                                    state.leaf_depth[new_leaf]))
+    if children_allowed is None:
+        children_allowed = (max_depth <= 0) | (child_depth < max_depth)
+
+    left_info = find_best_split(
+        hist_left, rec.left_sum_grad, rec.left_sum_hess,
+        rec.left_count, rec.left_total_count, meta, params,
+        mask_left, state.cand_left_min[leaf],
+        state.cand_left_max[leaf],
+        parent_output=rec.left_output,
+        rand_bins=_maybe_rand_bins(extra_trees, rand_seed, 2 * new_leaf,
+                                   meta, params),
+        gain_penalty=pen_left, leaf_depth=child_depth,
+        has_categorical=has_cat)
+    right_info = find_best_split(
+        hist_right, rec.right_sum_grad, rec.right_sum_hess,
+        rec.right_count, rec.right_total_count, meta, params,
+        mask_right, state.cand_right_min[leaf],
+        state.cand_right_max[leaf],
+        parent_output=rec.right_output,
+        rand_bins=_maybe_rand_bins(extra_trees, rand_seed,
+                                   2 * new_leaf + 1, meta, params),
+        gain_penalty=pen_right, leaf_depth=child_depth,
+        has_categorical=has_cat)
+
+    state = state._replace(leaf_depth=leaf_depth)
+    state = _store_info(state, leaf, left_info, children_allowed, valid)
+    state = _store_info(state, new_leaf, right_info, children_allowed,
+                        valid)
+    return state
 
 
 def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
@@ -369,42 +453,13 @@ def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
         .at[new_leaf].set(
             jnp.where(valid, hist_right, state.hists[new_leaf]))
 
-    child_depth = state.leaf_depth[leaf] + 1
-    leaf_depth = state.leaf_depth \
-        .at[leaf].set(jnp.where(valid, child_depth,
-                                state.leaf_depth[leaf])) \
-        .at[new_leaf].set(jnp.where(valid, child_depth,
-                                    state.leaf_depth[new_leaf]))
-    if children_allowed is None:
-        children_allowed = (max_depth <= 0) | (child_depth < max_depth)
-
-    left_info = find_best_split(
-        hist_left, rec.left_sum_grad, rec.left_sum_hess,
-        rec.left_count, rec.left_total_count, meta, params,
-        mask_left, state.cand_left_min[leaf],
-        state.cand_left_max[leaf],
-        parent_output=rec.left_output,
-        rand_bins=_maybe_rand_bins(extra_trees, rand_seed, 2 * new_leaf,
-                                   meta, params),
-        gain_penalty=pen_left, leaf_depth=child_depth,
-        has_categorical=has_cat)
-    right_info = find_best_split(
-        hist_right, rec.right_sum_grad, rec.right_sum_hess,
-        rec.right_count, rec.right_total_count, meta, params,
-        mask_right, state.cand_right_min[leaf],
-        state.cand_right_max[leaf],
-        parent_output=rec.right_output,
-        rand_bins=_maybe_rand_bins(extra_trees, rand_seed,
-                                   2 * new_leaf + 1, meta, params),
-        gain_penalty=pen_right, leaf_depth=child_depth,
-        has_categorical=has_cat)
-
-    state = state._replace(leaf_of_row=leaf_of_row, hists=hists,
-                           leaf_depth=leaf_depth)
-    state = _store_info(state, leaf, left_info, children_allowed, valid)
-    state = _store_info(state, new_leaf, right_info, children_allowed,
-                        valid)
-    return state
+    state = state._replace(leaf_of_row=leaf_of_row, hists=hists)
+    return _finish_split(state, rec, leaf, new_leaf, valid, hist_left,
+                         hist_right, mask_left, mask_right, meta, params,
+                         max_depth=max_depth, extra_trees=extra_trees,
+                         has_cat=has_cat, rand_seed=rand_seed,
+                         pen_left=pen_left, pen_right=pen_right,
+                         children_allowed=children_allowed)
 
 
 @functools.lru_cache(maxsize=None)
@@ -677,8 +732,7 @@ def _batch_fn_cached(S: int, kb: int, B: int, Bg: int, bundled: bool,
             state, recs = carry
             best = jnp.argmax(state.gain).astype(jnp.int32)
             rec = _record_at(state, best)
-            valid = ((rec.feature >= 0) & jnp.isfinite(rec.gain)
-                     & (rec.gain > 0.0) & (i < max_splits))
+            valid = rec_valid(rec) & (i < max_splits)
             recs = jax.tree_util.tree_map(
                 lambda buf, v: buf.at[i].set(v), recs, rec)
             new_leaf = (start_leaf + i).astype(jnp.int32)
@@ -698,7 +752,7 @@ def _batch_fn_cached(S: int, kb: int, B: int, Bg: int, bundled: bool,
     return jax.jit(batch, donate_argnums=(1,))
 
 
-class SerialTreeLearner:
+class SerialTreeLearner(CapabilityMixin):
     """Leaf-wise grower over a device-resident binned dataset."""
 
     def __init__(self, config, dataset: BinnedDataset):
@@ -763,81 +817,6 @@ class SerialTreeLearner:
         self._init_cegb(config)
         self._init_monotone(config)
 
-    def _init_monotone(self, config) -> None:
-        """intermediate/advanced monotone methods route through the
-        host-tracked stepwise path (reference: the LeafConstraintsBase
-        hierarchy, monotone_constraints.hpp; advanced degrades to
-        intermediate — its per-threshold cumulative constraints are not
-        implemented)."""
-        self._mono_tracker = None
-        method = str(config.monotone_constraints_method)
-        mc = self.dataset.monotone_constraints
-        has_mono = mc is not None and any(int(v) != 0 for v in mc)
-        if not has_mono or method == "basic":
-            return
-        if self._cegb_enabled:
-            log.warning("CEGB takes precedence over "
-                        "monotone_constraints_method=%s; monotone "
-                        "constraints run in basic mode" % method)
-            return
-        if self._extra_trees:
-            log.warning("extra_trees is ignored under "
-                        "monotone_constraints_method=%s" % method)
-        if method == "advanced":
-            log.warning("monotone_constraints_method=advanced is not "
-                        "implemented; using intermediate")
-        from .monotone import IntermediateMonotoneTracker
-        # dataset.monotone_constraints is already inner-feature ordered
-        mono_inner = np.zeros(self.Fp, dtype=np.int8)
-        mono_inner[:self.F] = np.asarray(mc, dtype=np.int8)[:self.F]
-        self._mono_tracker = IntermediateMonotoneTracker(self.L,
-                                                         mono_inner)
-
-    # ------------------------------------------------------------------
-    def _init_cegb(self, config) -> None:
-        """CEGB setup (reference: CostEfficientGradientBoosting::IsEnable
-        + Init, cost_effective_gradient_boosting.hpp:27-68). The
-        used-features vector and (lazy mode) the per-(row, feature)
-        fetched matrix persist across trees, like the reference's
-        is_feature_used_in_split_ / feature_used_in_data_ members."""
-        coupled = list(config.cegb_penalty_feature_coupled or [])
-        lazy = list(config.cegb_penalty_feature_lazy or [])
-        self._cegb_enabled = (config.cegb_tradeoff < 1.0
-                              or config.cegb_penalty_split > 0.0
-                              or bool(coupled) or bool(lazy))
-        if not self._cegb_enabled:
-            return
-        if self._extra_trees:
-            log.warning("extra_trees is ignored when CEGB is enabled")
-        n_total = self.dataset.num_total_features
-        for name, vec in (("cegb_penalty_feature_coupled", coupled),
-                          ("cegb_penalty_feature_lazy", lazy)):
-            if vec and len(vec) != n_total:
-                log.fatal("%s should be the same size as feature number "
-                          "(%d vs %d)" % (name, len(vec), n_total))
-
-        def to_inner(vec):
-            out = np.zeros(self.Fp, dtype=np.float32)
-            if vec:
-                for j in range(self.F):
-                    out[j] = vec[self.dataset.real_feature_index(j)]
-            return jnp.asarray(out)
-
-        self._cegb_coupled = to_inner(coupled)
-        self._cegb_lazy = to_inner(lazy)
-        self._cegb_has_lazy = bool(lazy) and any(v != 0 for v in lazy)
-        self._cegb_used = jnp.zeros(self.Fp, dtype=bool)
-        if self._cegb_has_lazy:
-            if self.R * self.Fp > 3 * 10**8:
-                log.warning("cegb_penalty_feature_lazy tracks a "
-                            "[rows x features] matrix (%.1f GB)"
-                            % (self.R * self.Fp * 4 / 2**30))
-            self._cegb_fetched = jnp.zeros((self.R, self.Fp),
-                                           dtype=jnp.float32)
-        else:
-            self._cegb_fetched = jnp.zeros((1, self.Fp),
-                                           dtype=jnp.float32)
-
     # ------------------------------------------------------------------
     def _sample_features(self) -> jnp.ndarray:
         """Per-tree column sampling (reference: ColSampler,
@@ -856,46 +835,6 @@ class SerialTreeLearner:
             mask &= allowed
         return jnp.asarray(mask)
 
-    def _resolve_constraints(self):
-        """interaction_constraints (config.h:562): groups of inner feature
-        indices; a branch may only combine features co-occurring in at
-        least one group (reference: ColSampler::SetUsedFeatureByNode)."""
-        ic = self.config.interaction_constraints
-        if not ic:
-            self._constraint_groups = None
-            return
-        groups = []
-        for grp in ic:
-            inner = set()
-            for real_f in grp:
-                j = self.dataset.inner_feature_index(int(real_f))
-                if j >= 0:
-                    inner.add(j)
-            if inner:
-                groups.append(frozenset(inner))
-        self._constraint_groups = groups or None
-
-    def _node_mask(self, tree_mask: jnp.ndarray,
-                   path_features: frozenset) -> jnp.ndarray:
-        """Per-node mask: interaction constraints filtered by the
-        feature-path, plus feature_fraction_bynode sampling."""
-        mask = None
-        if self._constraint_groups is not None:
-            allowed = np.zeros(self.Fp, dtype=bool)
-            for grp in self._constraint_groups:
-                if path_features <= grp:
-                    allowed[list(grp)] = True
-            mask = allowed
-        ffb = float(self.config.feature_fraction_bynode)
-        if 0.0 < ffb < 1.0:
-            m2 = np.zeros(self.Fp, dtype=bool)
-            k = max(1, int(round(self.F * ffb)))
-            m2[self._ff_rng.choice(self.F, k, replace=False)] = True
-            mask = m2 if mask is None else (mask & m2)
-        if mask is None:
-            return tree_mask
-        return tree_mask & jnp.asarray(mask)
-
     # ------------------------------------------------------------------
     def _build_bundle_tables(self, dataset: BinnedDataset) -> None:
         """Device EFB tables (or a dummy scalar when unbundled)."""
@@ -903,25 +842,9 @@ class SerialTreeLearner:
             self.Bg = 0
             self._btab = jnp.int32(0)
             return
-        lay = dataset.bundle
-        G = lay.num_groups
-        self.Bg = _next_pow2(max(lay.num_bundled_bins, 2))
-        member = np.full((self.Gp, self.Bg), -1, dtype=np.int32)
-        member[:G, :lay.member.shape[1]] = lay.member
-        unmap = np.zeros((self.Gp, self.Bg), dtype=np.int32)
-        unmap[:G, :lay.unmap.shape[1]] = lay.unmap
-        group_of = np.zeros(self.Fp, dtype=np.int32)
-        group_of[:self.F] = lay.group_of
-        gidx_g = np.full((self.Fp, self.B), -1, dtype=np.int32)
-        gidx_b = np.zeros((self.Fp, self.B), dtype=np.int32)
-        gidx_g[:self.F, :lay.gidx_g.shape[1]] = lay.gidx_g
-        gidx_b[:self.F, :lay.gidx_b.shape[1]] = lay.gidx_b
-        zero_fix = np.zeros(self.Fp, dtype=bool)
-        zero_fix[:self.F] = lay.needs_zero_fix
-        self._btab = BundleTables(
-            group_of=jnp.asarray(group_of), member=jnp.asarray(member),
-            unmap=jnp.asarray(unmap), gidx_g=jnp.asarray(gidx_g),
-            gidx_b=jnp.asarray(gidx_b), zero_fix=jnp.asarray(zero_fix))
+        self.Bg = _next_pow2(max(dataset.bundle.num_bundled_bins, 2))
+        self._btab = build_bundle_tables(dataset, self.Fp, self.Gp,
+                                         self.B, self.Bg)
 
     def _step_fn(self, S: int):
         return _step_fn_cached(S, self.B, self.Bg, self._bundled,
@@ -1045,11 +968,11 @@ class SerialTreeLearner:
         rand_seed = jnp.int32(
             (self._extra_seed + 7919 * self._tree_idx) & 0x7FFFFFFF)
         if self._cegb_enabled:
-            state = self._train_cegb(tree, gh, feature_mask)
+            state = train_cegb(self, tree, gh, feature_mask)
             return tree, state.leaf_of_row[:self.N]
         if self._mono_tracker is not None:
-            state = self._train_monotone(tree, gh, feature_mask,
-                                         rand_seed)
+            state = train_monotone(self, tree, gh, feature_mask,
+                                   rand_seed)
             return tree, state.leaf_of_row[:self.N]
         state, rec = self._root_fn(self.bins, gh, self._leaf_of_row0,
                                    feature_mask, self._splittable(0),
@@ -1060,15 +983,13 @@ class SerialTreeLearner:
         if self._forced is not None:
             state, next_leaf = self._apply_forced_splits(
                 tree, state, feature_mask, rand_seed, leaf_total)
-        per_node = (self._constraint_groups is not None
-                    or 0.0 < float(self.config.feature_fraction_bynode)
-                    < 1.0)
+        per_node = self._needs_per_node_masks()
         if per_node and self._forced is not None:
             log.warning("forced splits combined with per-node feature "
                         "masks run without the per-node masks")
         if per_node and self._forced is None:
-            state = self._train_stepwise(tree, state, rec, feature_mask,
-                                         rand_seed)
+            state = train_stepwise(self, tree, state, rec, feature_mask,
+                                   rand_seed)
         else:
             state = self._train_batched(tree, state, feature_mask,
                                         rand_seed, leaf_total, next_leaf)
@@ -1104,158 +1025,65 @@ class SerialTreeLearner:
                 break
         return state
 
-    def _train_cegb(self, tree: Tree, gh, feature_mask) -> GrowState:
-        """CEGB growth: one host round-trip per split so penalties track
-        the evolving used/fetched state (reference: the DeltaGain calls
-        inside FindBestSplitsFromHistograms,
-        serial_tree_learner.cpp:375+)."""
-        if self._forced is not None or self._constraint_groups is not None:
-            log.warning("CEGB runs without forced splits / per-node "
-                        "feature masks")
+    # --- adapter methods for the shared capability drivers
+    # (treelearner/capabilities.py): each wraps this learner's cached
+    # jitted step functions with its bucketed gather size ---------------
+
+    def _cegb_root(self, gh, feature_mask):
         root = _cegb_root_fn_cached(self.L, self.B, self.Bg,
                                     self._bundled, self._cegb_has_lazy,
                                     self._has_cat, self._hist_impl)
-        state, rec = root(self.bins, gh, self._leaf_of_row0, feature_mask,
-                          self._splittable(0), self._cegb_used,
-                          self._cegb_fetched, self._cegb_coupled,
-                          self._cegb_lazy, self.meta, self.params,
-                          self._btab)
-        pending = jax.device_get(rec)
-        for k in range(1, self.L):
-            if not record_is_valid(pending):
-                break
-            leaf = int(pending.leaf)
-            apply_split_record(tree, self.dataset, pending)
-            children_allowed = self._splittable(int(tree.leaf_depth[leaf]))
-            smaller = min(float(pending.left_total_count),
-                          float(pending.right_total_count))
-            S = self._bucket(smaller)
-            fn = _cegb_step_fn_cached(S, self.B, self.Bg, self._bundled,
-                                      self._cegb_has_lazy,
-                                      self._has_cat, self._hist_impl)
-            state, rec, self._cegb_used, self._cegb_fetched = fn(
-                self.bins, state, jnp.int32(leaf), jnp.int32(k),
-                jnp.asarray(children_allowed), feature_mask,
-                self._cegb_used, self._cegb_fetched, self._cegb_coupled,
-                self._cegb_lazy, self.meta, self.params, self._btab)
-            pending = jax.device_get(rec)
-        return state
+        return root(self.bins, gh, self._leaf_of_row0, feature_mask,
+                    self._splittable(0), self._cegb_used,
+                    self._cegb_fetched, self._cegb_coupled,
+                    self._cegb_lazy, self.meta, self.params, self._btab)
 
-    def _train_monotone(self, tree: Tree, gh, feature_mask,
-                        rand_seed) -> GrowState:
-        """monotone_constraints_method=intermediate growth: stepwise with
-        host-tracked bounds + contiguous-leaf rescans (reference:
-        SerialTreeLearner::Split → constraints_->Update →
-        RecomputeBestSplitForLeaf, serial_tree_learner.cpp:702-710)."""
-        tracker = self._mono_tracker
-        tracker.reset()
-        if self._forced is not None:
-            log.warning("forced splits are ignored under "
-                        "monotone_constraints_method=intermediate")
-        if self._constraint_groups is not None:
-            log.warning("interaction constraints are ignored under "
-                        "monotone_constraints_method=intermediate")
+    def _cegb_step(self, state, leaf, k, allowed, feature_mask, smaller):
+        S = self._bucket(smaller)
+        fn = _cegb_step_fn_cached(S, self.B, self.Bg, self._bundled,
+                                  self._cegb_has_lazy,
+                                  self._has_cat, self._hist_impl)
+        state, rec, self._cegb_used, self._cegb_fetched = fn(
+            self.bins, state, jnp.int32(leaf), jnp.int32(k),
+            jnp.asarray(allowed), feature_mask,
+            self._cegb_used, self._cegb_fetched, self._cegb_coupled,
+            self._cegb_lazy, self.meta, self.params, self._btab)
+        return state, rec
+
+    def _mono_root(self, gh, feature_mask, rand_seed):
         # extra_trees is ignored on this path — the root scan must be
         # greedy too, not just the step scans
         root_fn = _root_fn_cached(self.L, self.B, self.Bg, self._bundled,
-                                  False, self._has_cat,
-                                  self._hist_impl)
-        state, rec = root_fn(self.bins, gh, self._leaf_of_row0,
-                             feature_mask, self._splittable(0),
-                             rand_seed, self.meta, self.params,
-                             self._btab)
-        pending = jax.device_get(rec)
-        gains_h = None
-        leaf_sums: dict = {}
-        rescan = _rescan_fn_cached(self.B, self._has_cat)
-        for k in range(1, self.L):
-            if not record_is_valid(pending):
-                break
-            leaf = int(pending.leaf)
-            f_inner = int(pending.feature)
-            mono_type = int(tracker.mono[f_inner])
-            if leaf == 0 and 0 not in leaf_sums:
-                leaf_sums[0] = (
-                    float(pending.left_sum_grad)
-                    + float(pending.right_sum_grad),
-                    float(pending.left_sum_hess)
-                    + float(pending.right_sum_hess),
-                    float(pending.left_count)
-                    + float(pending.right_count),
-                    float(pending.left_total_count)
-                    + float(pending.right_total_count))
-            tracker.before_split(tree, leaf, mono_type)
-            apply_split_record(tree, self.dataset, pending)
-            lo, ro = float(pending.left_output), \
-                float(pending.right_output)
-            bounds = tracker.child_bounds(leaf, mono_type, lo, ro)
-            tracker.apply_split(tree, leaf, k, bounds)
-            leaf_sums[leaf] = (float(pending.left_sum_grad),
-                               float(pending.left_sum_hess),
-                               float(pending.left_count),
-                               float(pending.left_total_count))
-            leaf_sums[k] = (float(pending.right_sum_grad),
-                            float(pending.right_sum_hess),
-                            float(pending.right_count),
-                            float(pending.right_total_count))
-            children_allowed = self._splittable(int(tree.leaf_depth[leaf]))
-            smaller = min(float(pending.left_total_count),
-                          float(pending.right_total_count))
-            S = self._bucket(smaller)
-            fn = _mono_step_fn_cached(S, self.B, self.Bg,
-                                      self._bundled, self._has_cat,
-                                      self._hist_impl)
-            applied_tbin = int(pending.threshold_bin)
-            applied_numerical = not bool(pending.is_categorical)
-            state, rec, gains_d = fn(
-                self.bins, state, jnp.int32(leaf), jnp.int32(k),
-                jnp.asarray(children_allowed), feature_mask,
-                jnp.float32(bounds[0]), jnp.float32(bounds[1]),
-                jnp.float32(bounds[2]), jnp.float32(bounds[3]),
-                self.meta, self.params, self._btab)
-            pending, gains_h = jax.device_get((rec, gains_d))
-            # propagate to contiguous leaves + rescan them
-            upd = tracker.leaves_to_update(
-                tree, k, f_inner, applied_tbin, lo, ro,
-                applied_numerical,
-                lambda l: (l <= k and np.isfinite(gains_h[l])))
-            for l in upd:
-                emin, emax = tracker.entries[l]
-                sg, sh, c, tc = leaf_sums[l]
-                allowed_l = self._splittable(int(tree.leaf_depth[l]))
-                state, rec, gains_d = rescan(
-                    state, jnp.int32(l), jnp.float32(sg),
-                    jnp.float32(sh), jnp.float32(c), jnp.float32(tc),
-                    jnp.float32(emin), jnp.float32(emax),
-                    jnp.int32(tree.leaf_depth[l]),
-                    jnp.asarray(allowed_l), feature_mask, self.meta,
-                    self.params, self._btab)
-            if upd:
-                pending, gains_h = jax.device_get((rec, gains_d))
-        return state
+                                  False, self._has_cat, self._hist_impl)
+        return root_fn(self.bins, gh, self._leaf_of_row0, feature_mask,
+                       self._splittable(0), rand_seed, self.meta,
+                       self.params, self._btab)
 
-    def _train_stepwise(self, tree: Tree, state: GrowState, rec,
-                        feature_mask, rand_seed=0) -> GrowState:
-        """One host round-trip per split — needed when per-node feature
-        masks depend on the host-side feature path."""
-        pending = jax.device_get(rec)
-        paths = {0: frozenset()}
-        for k in range(1, self.L):
-            if not record_is_valid(pending):
-                break
-            leaf = int(pending.leaf)
-            f = int(pending.feature)
-            apply_split_record(tree, self.dataset, pending)
-            children_allowed = self._splittable(int(tree.leaf_depth[leaf]))
-            smaller = min(float(pending.left_total_count),
-                          float(pending.right_total_count))
-            S = self._bucket(smaller)
-            paths[leaf] = paths[k] = paths.get(leaf, frozenset()) | {f}
-            mask_left = self._node_mask(feature_mask, paths[leaf])
-            mask_right = self._node_mask(feature_mask, paths[k])
-            state, rec = self._step_fn(S)(
-                self.bins, state, jnp.int32(leaf), jnp.int32(k),
-                jnp.asarray(children_allowed), mask_left, mask_right,
-                rand_seed, self.meta, self.params, self._btab)
-            pending = jax.device_get(rec)
-        return state
+    def _mono_step(self, state, leaf, k, allowed, feature_mask, bounds,
+                   smaller):
+        S = self._bucket(smaller)
+        fn = _mono_step_fn_cached(S, self.B, self.Bg, self._bundled,
+                                  self._has_cat, self._hist_impl)
+        return fn(self.bins, state, jnp.int32(leaf), jnp.int32(k),
+                  jnp.asarray(allowed), feature_mask,
+                  jnp.float32(bounds[0]), jnp.float32(bounds[1]),
+                  jnp.float32(bounds[2]), jnp.float32(bounds[3]),
+                  self.meta, self.params, self._btab)
+
+    def _mono_rescan(self, state, leaf, sums, entry, depth, allowed,
+                     feature_mask):
+        rescan = _rescan_fn_cached(self.B, self._has_cat)
+        sg, sh, c, tc = sums
+        return rescan(state, jnp.int32(leaf), jnp.float32(sg),
+                      jnp.float32(sh), jnp.float32(c), jnp.float32(tc),
+                      jnp.float32(entry[0]), jnp.float32(entry[1]),
+                      jnp.int32(depth), jnp.asarray(allowed),
+                      feature_mask, self.meta, self.params, self._btab)
+
+    def _node_step(self, state, leaf, k, allowed, mask_left, mask_right,
+                   rand_seed, smaller):
+        S = self._bucket(smaller)
+        return self._step_fn(S)(
+            self.bins, state, jnp.int32(leaf), jnp.int32(k),
+            jnp.asarray(allowed), mask_left, mask_right, rand_seed,
+            self.meta, self.params, self._btab)

@@ -108,13 +108,11 @@ class TestVotingParallel:
         gh_sds = jax.ShapeDtypeStruct((dist.R, 4), jnp.float32)
         bins_sds = jax.ShapeDtypeStruct(dist.bins.shape, dist.bins.dtype)
         mask_sds = jax.ShapeDtypeStruct((dist.F,), jnp.bool_)
-        state_sds, _ = jax.eval_shape(
-            dist._root_impl, bins_sds, gh_sds, mask_sds,
-            jax.ShapeDtypeStruct((), jnp.bool_))
+        i32 = jax.ShapeDtypeStruct((), jnp.int32)
+        state_sds, _ = jax.eval_shape(dist._root_impl, bins_sds, gh_sds,
+                                      mask_sds, i32)
         lowered = jax.jit(dist._step_impl).lower(
-            bins_sds, state_sds, jax.ShapeDtypeStruct((), jnp.int32),
-            jax.ShapeDtypeStruct((), jnp.int32),
-            jax.ShapeDtypeStruct((), jnp.bool_), mask_sds)
+            bins_sds, state_sds, i32, i32, mask_sds, mask_sds, i32)
         hlo = lowered.as_text()
         F, B, V = dist.F, dist.B, dist.n_voted
         # all-reduces over f32 histogram payloads: largest must be the
