@@ -185,6 +185,21 @@ def run(argv: Optional[List[str]] = None) -> int:
     if task in ("predict", "prediction", "test"):
         booster = Booster(params=params, model_file=config.input_model)
         X, _, _, _ = _load_tabular(config.data, config)
+        # Text features are mapped by index (reference predictor
+        # semantics): a LibSVM/CSV test file whose max feature index is
+        # below the training width still predicts — pad with zeros
+        # (LibSVM's implicit value); extra trailing columns are dropped.
+        n_feat = booster.inner.max_feature_idx + 1
+        X = np.asarray(X)
+        if X.ndim == 2 and X.shape[1] < n_feat:
+            X = np.concatenate(
+                [X, np.zeros((X.shape[0], n_feat - X.shape[1]),
+                             dtype=X.dtype)], axis=1)
+        elif X.ndim == 2 and X.shape[1] > n_feat:
+            log.warning("prediction data has %d features; model was "
+                        "trained with %d — extra columns ignored"
+                        % (X.shape[1], n_feat))
+            X = X[:, :n_feat]
         pred = booster.predict(
             X, raw_score=bool(config.predict_raw_score),
             pred_leaf=bool(config.predict_leaf_index),

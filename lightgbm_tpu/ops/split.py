@@ -254,7 +254,8 @@ def find_best_split(hist: jnp.ndarray,
                     rand_bins=None,
                     gain_penalty=None,
                     leaf_depth=None,
-                    has_categorical: bool = True) -> SplitInfo:
+                    has_categorical: bool = True,
+                    bound_arrays=None) -> SplitInfo:
     """Scan a leaf histogram for the best (feature, threshold) pair.
 
     Parameters
@@ -270,6 +271,19 @@ def find_best_split(hist: jnp.ndarray,
       features the one-hot/sorted-subset scans (two argsorts plus a
       sequential 256-step lax.scan) are compiled out entirely; they are
       dead weight in every split step of an all-numerical dataset.
+    bound_arrays : monotone_constraints_method=advanced only — a
+      ``(min_c, max_c)`` pair of f32[F, B] per-(feature, bin) output
+      constraints (reference: AdvancedFeatureConstraints' piecewise
+      thresholds/constraints lists, monotone_constraints.hpp:260,
+      expanded dense over the bin axis; pad bins must carry -inf/+inf).
+      The per-threshold left/right child bounds are their running
+      extrema (reference: CumulativeFeatureConstraint,
+      monotone_constraints.hpp:144 — a left child covering bins
+      ``[0, t]`` is clamped by every constraint piece overlapping it,
+      the right child by pieces overlapping ``[t+1, ...)``); candidates
+      whose clamp interval inverts are rejected, mirroring the
+      ``best_*_constraints.min > .max → continue`` skip in
+      feature_histogram.hpp:950.
     """
     F, B, _ = hist.shape
     g, h, c, tc = hist[..., 0], hist[..., 1], hist[..., 2], hist[..., 3]
@@ -280,14 +294,37 @@ def find_best_split(hist: jnp.ndarray,
     if parent_output is None:
         parent_output = jnp.float32(0.0)
 
-    def bounded_output(sg, sh, n, l2=None):
+    if bound_arrays is not None:
+        min_c, max_c = bound_arrays                              # [F, B]
+        lmin_b = jax.lax.cummax(min_c, axis=1)                   # [F, B]
+        lmax_b = jax.lax.cummin(max_c, axis=1)
+        neg = jnp.full((F, 1), -jnp.inf, dtype=jnp.float32)
+        pos = jnp.full((F, 1), jnp.inf, dtype=jnp.float32)
+        rmin_b = jnp.concatenate(
+            [jax.lax.cummax(min_c, axis=1, reverse=True)[:, 1:], neg], 1)
+        rmax_b = jnp.concatenate(
+            [jax.lax.cummin(max_c, axis=1, reverse=True)[:, 1:], pos], 1)
+        bounds_ok = (lmin_b <= lmax_b) & (rmin_b <= rmax_b)      # [F, B]
+        # categorical splits see the leaf-wide (threshold-independent)
+        # clamp — pad bins are ±inf-neutral so the row extremum is the
+        # most restrictive piece
+        flat_min = jnp.max(min_c, axis=1)[:, None]               # [F, 1]
+        flat_max = jnp.min(max_c, axis=1)[:, None]
+    else:
+        flat_min = min_output
+        flat_max = max_output
+
+    def bounded_output(sg, sh, n, l2=None, lo=None, hi=None):
         out = calculate_leaf_output(sg, sh, params, l2)
         out = smooth_output(out, n, parent_output, params)
-        return jnp.clip(out, min_output, max_output)
+        lo = min_output if lo is None else lo
+        hi = max_output if hi is None else hi
+        return jnp.clip(out, lo, hi)
 
     def bounded_gain(sg, sh, n, l2=None):
         return leaf_gain_given_output(
-            sg, sh, bounded_output(sg, sh, n, l2), params, l2)
+            sg, sh, bounded_output(sg, sh, n, l2, flat_min, flat_max),
+            params, l2)
 
     is_cat = meta.is_categorical                                 # [F]
     is_num = ~is_cat
@@ -329,8 +366,13 @@ def find_best_split(hist: jnp.ndarray,
               (rc >= params.min_data_in_leaf) &
               (lh >= params.min_sum_hessian_in_leaf) &
               (rh >= params.min_sum_hessian_in_leaf))
-        out_l = bounded_output(lg, lh, lc)
-        out_r = bounded_output(rg, rh, rc)
+        if bound_arrays is not None:
+            out_l = bounded_output(lg, lh, lc, lo=lmin_b, hi=lmax_b)
+            out_r = bounded_output(rg, rh, rc, lo=rmin_b, hi=rmax_b)
+            ok = ok & bounds_ok
+        else:
+            out_l = bounded_output(lg, lh, lc)
+            out_r = bounded_output(rg, rh, rc)
         # monotone filtering (reference: BasicLeafConstraints split
         # rejection, monotone_constraints.hpp)
         mono_ok = ~(((mono > 0) & (out_l > out_r))
@@ -562,8 +604,23 @@ def find_best_split(hist: jnp.ndarray,
     else:
         cat_mask = jnp.zeros(B, dtype=bool)
         out_l2 = params.lambda_l2
-    out_left = bounded_output(lg, lh, lc, out_l2)
-    out_right = bounded_output(rg, rh, rc, out_l2)
+    if bound_arrays is not None:
+        # the winner's outputs must carry the same per-threshold clamp
+        # the gain scan used (reference: CalculateSplittedLeafOutput
+        # with best_left/right_constraints, feature_histogram.hpp:1060)
+        w_lmin = jnp.where(winner_is_cat, flat_min[feature, 0],
+                           lmin_b[feature, tbin])
+        w_lmax = jnp.where(winner_is_cat, flat_max[feature, 0],
+                           lmax_b[feature, tbin])
+        w_rmin = jnp.where(winner_is_cat, flat_min[feature, 0],
+                           rmin_b[feature, tbin])
+        w_rmax = jnp.where(winner_is_cat, flat_max[feature, 0],
+                           rmax_b[feature, tbin])
+        out_left = bounded_output(lg, lh, lc, out_l2, w_lmin, w_lmax)
+        out_right = bounded_output(rg, rh, rc, out_l2, w_rmin, w_rmax)
+    else:
+        out_left = bounded_output(lg, lh, lc, out_l2)
+        out_right = bounded_output(rg, rh, rc, out_l2)
     # children bounds (reference: BasicLeafConstraints::Update — the
     # mid-point between child outputs caps the monotone side)
     mc_w = jnp.where(winner_is_cat, 0,

@@ -63,7 +63,8 @@ from ..treelearner.serial import (GrowState, SplitRecord, _cegb_penalty,
                                   _go_left_by_bin, _maybe_rand_bins,
                                   _partition_col, _record_at, _store_info,
                                   apply_split_record, build_bundle_tables,
-                                  make_root_state, record_is_valid)
+                                  make_root_state, rec_valid,
+                                  record_is_valid)
 from ..utils import log
 
 
@@ -173,6 +174,8 @@ class DataParallelTreeLearner(CapabilityMixin):
         self._step_fn = None
         self._cegb_root_fn = None
         self._mono_step_fn = None
+        self._mono_root_fn = None
+        self._adv_rescan_fn = None
         return cols_host
 
     def _make_cegb_fetched(self, rows: int) -> jnp.ndarray:
@@ -472,6 +475,39 @@ class DataParallelTreeLearner(CapabilityMixin):
         state = _store_info(state, leaf, info, allowed)
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), state.gain
+
+    def _adv_rescan_impl(self, state, leaf, sg, sh, c, tc, min_c, max_c,
+                         depth, allowed, feature_mask):
+        """monotone_constraints_method=advanced candidate scan — the
+        per-(feature, bin) constraint arrays (replicated inputs) replace
+        the leaf-wide pair (reference: AdvancedLeafConstraints,
+        monotone_constraints.hpp:856; serial analogue
+        _adv_rescan_fn_cached in treelearner/serial.py)."""
+        hist = state.hists[leaf]
+        own = calculate_leaf_output(sg, sh, self.params)
+        parent_out = jnp.where(self.params.path_smooth > 1e-10, own, 0.0)
+        info = find_best_split(hist, sg, sh, c, tc, self.meta,
+                               self.params, feature_mask,
+                               parent_output=parent_out,
+                               leaf_depth=depth,
+                               has_categorical=self._has_cat,
+                               bound_arrays=(min_c, max_c))
+        state = _store_info(state, leaf, info, allowed)
+        best = jnp.argmax(state.gain).astype(jnp.int32)
+        return state, _record_at(state, best), state.gain
+
+    def _adv_scan(self, state, leaf, sums, bound_arrays, depth, allowed,
+                  feature_mask):
+        if self._adv_rescan_fn is None:
+            self._adv_rescan_fn = jax.jit(self._adv_rescan_impl,
+                                          donate_argnums=(0,))
+        sg, sh, c, tc = sums
+        min_c, max_c = bound_arrays
+        return self._adv_rescan_fn(
+            state, jnp.int32(leaf), jnp.float32(sg), jnp.float32(sh),
+            jnp.float32(c), jnp.float32(tc), jnp.asarray(min_c),
+            jnp.asarray(max_c), jnp.int32(depth), jnp.asarray(allowed),
+            feature_mask)
 
     # --- adapter methods for the shared capability drivers ------------
     def _cegb_root(self, gh, feature_mask):

@@ -176,12 +176,22 @@ def train(params: Dict, local_X: np.ndarray, local_y: np.ndarray,
                     m.init(ds.metadata, n_local)
                     local_vals = m.eval(
                         score[:, 0] if K == 1 else score, objective)
-                    # sum-decomposable metrics reduce exactly; others
-                    # (auc, ndcg) are per-shard approximations
+                    # sum-decomposable metrics reduce exactly; the
+                    # rank/AUC family is a per-shard approximation —
+                    # classify from the metric's canonical name, not the
+                    # user's alias string
                     red = _allreduce_sum([local_vals[0] * n_local,
                                           float(n_local)])
-                    log.info("[%d] global %s: %.6f"
-                             % (it + 1, mname, red[0] / red[1]))
+                    canon = (m.name[0] if isinstance(m.name, (list, tuple))
+                             else str(m.name))
+                    approx = any(canon.startswith(p) for p in
+                                 ("auc", "ndcg", "map",
+                                  "average_precision"))
+                    log.info("[%d] %s %s: %.6f"
+                             % (it + 1,
+                                "shard-avg approx" if approx
+                                else "global",
+                                mname, red[0] / red[1]))
                 except Exception as e:
                     log.warning("metric %s failed: %s" % (mname, e))
 

@@ -162,11 +162,16 @@ class CapabilityMixin:
         mono_inner = np.zeros(self.Fp, dtype=np.int8)
         mono_inner[:n_real] = np.asarray(mc, dtype=np.int8)[:n_real]
         if method == "advanced":
-            log.warning("monotone_constraints_method=advanced is not "
-                        "implemented; using intermediate")
-        from .monotone import IntermediateMonotoneTracker
-        self._mono_tracker = IntermediateMonotoneTracker(self.L,
-                                                         mono_inner)
+            from .monotone import AdvancedMonotoneTracker
+            num_bin = np.ones(self.Fp, dtype=np.int64)
+            nbpf = self.dataset.num_bin_per_feature
+            num_bin[:len(nbpf)] = nbpf
+            self._mono_tracker = AdvancedMonotoneTracker(
+                self.L, mono_inner, num_bin, self.B)
+        else:
+            from .monotone import IntermediateMonotoneTracker
+            self._mono_tracker = IntermediateMonotoneTracker(self.L,
+                                                             mono_inner)
 
 
 # ----------------------------------------------------------------------
@@ -206,10 +211,18 @@ def train_monotone(learner, tree, gh, feature_mask, rand_seed):
     """monotone_constraints_method=intermediate/advanced growth:
     stepwise with host-tracked bounds + contiguous-leaf rescans
     (reference: SerialTreeLearner::Split → constraints_->Update →
-    RecomputeBestSplitForLeaf, serial_tree_learner.cpp:702-710)."""
+    RecomputeBestSplitForLeaf, serial_tree_learner.cpp:702-710).
+
+    The advanced method additionally recomputes both fresh children
+    with their per-(feature, bin) constraint arrays (the reference's
+    lazily-recomputed AdvancedLeafConstraints,
+    monotone_constraints.hpp:856) — the scalar-bound candidates from
+    the shared step are overwritten by an ``_adv_scan`` per child."""
+    from .monotone import AdvancedMonotoneTracker
     from .serial import apply_split_record, record_is_valid
 
     tracker = learner._mono_tracker
+    advanced = isinstance(tracker, AdvancedMonotoneTracker)
     tracker.reset()
     if getattr(learner, "_forced", None) is not None:
         log.warning("forced splits are ignored under "
@@ -243,8 +256,14 @@ def train_monotone(learner, tree, gh, feature_mask, rand_seed):
         apply_split_record(tree, learner.dataset, pending)
         lo, ro = float(pending.left_output), \
             float(pending.right_output)
-        bounds = tracker.child_bounds(leaf, mono_type, lo, ro)
-        tracker.apply_split(tree, leaf, k, bounds)
+        applied_numerical = not bool(pending.is_categorical)
+        if advanced:
+            tracker.apply_split_outputs(leaf, k, mono_type, lo, ro,
+                                        applied_numerical)
+            bounds = (-np.inf, np.inf, -np.inf, np.inf)
+        else:
+            bounds = tracker.child_bounds(leaf, mono_type, lo, ro)
+            tracker.apply_split(tree, leaf, k, bounds)
         leaf_sums[leaf] = (float(pending.left_sum_grad),
                            float(pending.left_sum_hess),
                            float(pending.left_count),
@@ -257,9 +276,17 @@ def train_monotone(learner, tree, gh, feature_mask, rand_seed):
         smaller = min(float(pending.left_total_count),
                       float(pending.right_total_count))
         applied_tbin = int(pending.threshold_bin)
-        applied_numerical = not bool(pending.is_categorical)
         state, rec, gains_d = learner._mono_step(
             state, leaf, k, allowed, feature_mask, bounds, smaller)
+        if advanced:
+            # overwrite both children's candidates with the
+            # per-threshold-constrained scan
+            for child in (leaf, k):
+                d = int(tree.leaf_depth[child])
+                arrs = tracker.leaf_bound_arrays(tree, child)
+                state, rec, gains_d = learner._adv_scan(
+                    state, child, leaf_sums[child], arrs, d,
+                    learner._splittable(d), feature_mask)
         pending, gains_h = jax.device_get((rec, gains_d))
         # propagate to contiguous leaves + rescan them
         upd = tracker.leaves_to_update(
@@ -267,11 +294,17 @@ def train_monotone(learner, tree, gh, feature_mask, rand_seed):
             applied_numerical,
             lambda l: (l <= k and np.isfinite(gains_h[l])))
         for l in upd:
-            emin, emax = tracker.entries[l]
             allowed_l = learner._splittable(int(tree.leaf_depth[l]))
-            state, rec, gains_d = learner._mono_rescan(
-                state, l, leaf_sums[l], (emin, emax),
-                int(tree.leaf_depth[l]), allowed_l, feature_mask)
+            if advanced:
+                arrs = tracker.leaf_bound_arrays(tree, l)
+                state, rec, gains_d = learner._adv_scan(
+                    state, l, leaf_sums[l], arrs,
+                    int(tree.leaf_depth[l]), allowed_l, feature_mask)
+            else:
+                emin, emax = tracker.entries[l]
+                state, rec, gains_d = learner._mono_rescan(
+                    state, l, leaf_sums[l], (emin, emax),
+                    int(tree.leaf_depth[l]), allowed_l, feature_mask)
         if upd:
             pending, gains_h = jax.device_get((rec, gains_d))
     return state

@@ -667,6 +667,31 @@ def _rescan_fn_cached(B: int, has_cat: bool = True):
 
 
 @functools.lru_cache(maxsize=None)
+def _adv_rescan_fn_cached(B: int, has_cat: bool = True):
+    """monotone_constraints_method=advanced candidate scan: the leaf's
+    per-(feature, bin) constraint arrays replace the leaf-wide bound
+    pair (reference: AdvancedLeafConstraints feeding FindBestThreshold
+    through CumulativeFeatureConstraint,
+    monotone_constraints.hpp:856-1184 + feature_histogram.hpp:874-951)."""
+    def rescan(state: GrowState, leaf, sg, sh, c, tc, min_c, max_c,
+               depth, allowed, feature_mask, meta, params, btab):
+        hist = state.hists[leaf]
+        own = calculate_leaf_output(sg, sh, params)
+        parent_out = jnp.where(params.path_smooth > 1e-10, own, 0.0)
+        info = find_best_split(hist, sg, sh, c, tc, meta, params,
+                               feature_mask,
+                               parent_output=parent_out,
+                               leaf_depth=depth,
+                               has_categorical=has_cat,
+                               bound_arrays=(min_c, max_c))
+        state = _store_info(state, leaf, info, allowed)
+        best = jnp.argmax(state.gain).astype(jnp.int32)
+        return state, _record_at(state, best), state.gain
+
+    return jax.jit(rescan, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
 def _forced_fn_cached(S: int, B: int, Bg: int, bundled: bool,
                       extra_trees: bool, has_cat: bool = True,
                       hist_impl: tuple = ("auto", False)):
@@ -1079,6 +1104,17 @@ class SerialTreeLearner(CapabilityMixin):
                       jnp.float32(entry[0]), jnp.float32(entry[1]),
                       jnp.int32(depth), jnp.asarray(allowed),
                       feature_mask, self.meta, self.params, self._btab)
+
+    def _adv_scan(self, state, leaf, sums, bound_arrays, depth, allowed,
+                  feature_mask):
+        fn = _adv_rescan_fn_cached(self.B, self._has_cat)
+        sg, sh, c, tc = sums
+        min_c, max_c = bound_arrays
+        return fn(state, jnp.int32(leaf), jnp.float32(sg),
+                  jnp.float32(sh), jnp.float32(c), jnp.float32(tc),
+                  jnp.asarray(min_c), jnp.asarray(max_c),
+                  jnp.int32(depth), jnp.asarray(allowed), feature_mask,
+                  self.meta, self.params, self._btab)
 
     def _node_step(self, state, leaf, k, allowed, mask_left, mask_right,
                    rand_seed, smaller):

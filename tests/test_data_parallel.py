@@ -109,6 +109,9 @@ class TestDataParallel:
             ("monotone_intermediate",
              {"monotone_constraints": mono,
               "monotone_constraints_method": "intermediate"}, {}),
+            ("monotone_advanced",
+             {"monotone_constraints": mono,
+              "monotone_constraints_method": "advanced"}, {}),
             ("interaction_constraints",
              {"interaction_constraints": [[0, 1, 2], [3, 4, 5]]}, {}),
             ("bynode", {"feature_fraction_bynode": 0.5}, {}),

@@ -37,7 +37,7 @@ def _is_monotone(bst, X, feature, sign, n_grid=30):
     return True
 
 
-@pytest.mark.parametrize("method", ["basic", "intermediate"])
+@pytest.mark.parametrize("method", ["basic", "intermediate", "advanced"])
 def test_monotone_holds(method):
     X, y = _data()
     params = {"objective": "regression", "num_leaves": 31,
@@ -65,13 +65,43 @@ def test_intermediate_at_least_as_good_as_basic():
     assert scores["intermediate"] <= scores["basic"] * 1.1
 
 
-def test_advanced_falls_back_to_intermediate():
+def test_advanced_quality_tracks_intermediate():
+    """The advanced ("monotone precise") method computes exact
+    per-threshold constraints (reference: AdvancedLeafConstraints,
+    monotone_constraints.hpp:856) — its fit must not regress vs the
+    looser intermediate bounds (reference docs: 'slowest but most
+    accurate' ordering basic < intermediate < advanced)."""
     X, y = _data()
-    params = {"objective": "regression", "num_leaves": 15,
-              "verbosity": -1, "monotone_constraints": [1, -1, 0],
-              "monotone_constraints_method": "advanced"}
-    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
-    assert _is_monotone(bst, X, 0, +1)
+    scores = {}
+    for method in ("intermediate", "advanced"):
+        params = {"objective": "regression", "num_leaves": 31,
+                  "verbosity": -1, "min_data_in_leaf": 20,
+                  "monotone_constraints": [1, -1, 0],
+                  "monotone_constraints_method": method}
+        bst = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=20)
+        scores[method] = float(np.mean((bst.predict(X) - y) ** 2))
+        assert _is_monotone(bst, X, 0, +1), method
+        assert _is_monotone(bst, X, 1, -1), method
+    assert scores["advanced"] <= scores["intermediate"] * 1.1
+
+
+def test_advanced_differs_from_intermediate_when_constraints_bind():
+    """Advanced clamps each candidate split with only the leaves
+    actually contiguous with each child, so where bounds bind the two
+    methods must eventually pick different trees (otherwise the method
+    silently degraded — the round-4 behavior this test pins against)."""
+    X, y = _data(4000, seed=11)
+    preds = {}
+    for method in ("intermediate", "advanced"):
+        params = {"objective": "regression", "num_leaves": 63,
+                  "verbosity": -1, "min_data_in_leaf": 10,
+                  "monotone_constraints": [1, -1, 0],
+                  "monotone_constraints_method": method}
+        bst = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=30)
+        preds[method] = bst.predict(X)
+    assert not np.allclose(preds["advanced"], preds["intermediate"])
 
 
 def test_monotone_penalty_discourages_constrained_splits():

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Run python with the TPU-tunnel plugin env scrubbed and an 8-device
+# virtual CPU mesh — for one-off scripts/tests. The axon PJRT plugin
+# (PALLAS_AXON_POOL_IPS + PYTHONPATH=/root/.axon_site) can wedge ANY
+# jax init in-process when the tunnel is flaky, even under
+# JAX_PLATFORMS=cpu; scrubbing before interpreter start is the only
+# safe way (same trick as tests/conftest.py and
+# __graft_entry__.scrubbed_cpu_env).
+unset PALLAS_AXON_POOL_IPS PALLAS_AXON_REMOTE_COMPILE AXON_LOOPBACK_RELAY \
+      PALLAS_AXON_TPU_GEN
+export PYTHONPATH="$(echo "$PYTHONPATH" | tr ':' '\n' | \
+                     grep -v axon_site | paste -sd:)"
+export JAX_PLATFORMS=cpu JAX_PLATFORM_NAME=cpu
+case "$XLA_FLAGS" in
+  *xla_force_host_platform_device_count*) ;;
+  *) export XLA_FLAGS="$XLA_FLAGS --xla_force_host_platform_device_count=8" ;;
+esac
+exec python "$@"

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Periodically probe the TPU tunnel; on first success, write a marker
+# file so the session knows hardware is reachable. SIGTERM only (a
+# SIGKILL on a tunnel holder wedges the relay); generous timeout.
+MARKER=${1:-/tmp/tpu_alive}
+LOG=${2:-/tmp/tpu_probe_loop.log}
+while true; do
+  if timeout -s TERM 240 python -c "
+import jax
+d = jax.devices()
+import jax.numpy as jnp
+x = jnp.ones((256, 256), dtype=jnp.bfloat16)
+(x @ x).block_until_ready()
+print('PROBE_OK', d[0].platform, len(d))
+" >> "$LOG" 2>&1; then
+    date +"%F %T PROBE_OK" >> "$LOG"
+    touch "$MARKER"
+    exit 0
+  fi
+  date +"%F %T probe failed; sleeping 480s" >> "$LOG"
+  sleep 480
+done
