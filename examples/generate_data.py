@@ -59,9 +59,10 @@ def multiclass(n_train=900, n_test=240, seed=13):
         _write(os.path.join(d, name), y, X)
 
 
-def lambdarank(n_queries_train=40, n_queries_test=12, seed=14):
+def lambdarank(n_queries_train=40, n_queries_test=12, seed=14,
+               subdir="lambdarank"):
     rng = np.random.RandomState(seed)
-    d = os.path.join(HERE, "lambdarank")
+    d = os.path.join(HERE, subdir)
     os.makedirs(d, exist_ok=True)
     for name, nq in (("rank.train", n_queries_train),
                      ("rank.test", n_queries_test)):
@@ -88,4 +89,5 @@ if __name__ == "__main__":
     regression()
     multiclass()
     lambdarank()
+    lambdarank(subdir="xendcg")  # same layout, rank_xendcg objective
     print("examples data written under", HERE)

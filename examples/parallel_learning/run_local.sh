@@ -1,0 +1,14 @@
+#!/bin/bash
+# Two-rank fake cluster on localhost (the reference demos the same
+# setup in tests/distributed/_test_distributed.py). Each rank is a
+# normal CLI invocation; they rendezvous through the jax.distributed
+# coordinator (= first machine in mlist.txt).
+set -e
+cd "$(dirname "$0")"
+[ -f ../binary_classification/binary.train ] || python ../generate_data.py
+cp -f ../binary_classification/binary.train binary.train
+python -m lightgbm_tpu.application config=train.conf local_listen_port=12401 &
+RANK1=$!
+python -m lightgbm_tpu.application config=train.conf local_listen_port=12400
+wait $RANK1
+echo "model written by rank 0: LightGBM_model.txt"

@@ -1,0 +1,87 @@
+"""Advanced features example (reference:
+examples/python-guide/advanced_example.py — model management, custom
+objective/metric, continued training, parameter reset)."""
+import json
+import os
+import pickle
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DATA = os.path.join(HERE, os.pardir, "binary_classification")
+
+print("Loading data...")
+train = np.loadtxt(os.path.join(DATA, "binary.train"), delimiter="\t")
+test = np.loadtxt(os.path.join(DATA, "binary.test"), delimiter="\t")
+y_train, X_train = train[:, 0], train[:, 1:]
+y_test, X_test = test[:, 0], test[:, 1:]
+W_train = np.ones(len(y_train))
+
+lgb_train = lgb.Dataset(X_train, label=y_train, weight=W_train)
+lgb_eval = lgb.Dataset(X_test, label=y_test, reference=lgb_train)
+
+params = {"boosting_type": "gbdt", "objective": "binary",
+          "metric": "binary_logloss", "num_leaves": 31, "verbose": 0}
+
+evals_result = {}
+print("Starting training...")
+gbm = lgb.train(params, lgb_train, num_boost_round=10,
+                valid_sets=[lgb_train, lgb_eval],
+                valid_names=["train", "eval"],
+                callbacks=[lgb.record_evaluation(evals_result)])
+
+print("Dumping model to JSON...")
+model_json = gbm.dump_model()
+with open(os.path.join(HERE, "model.json"), "w") as f:
+    json.dump(model_json, f, indent=2)
+
+print(f"Feature names: {gbm.feature_name()}")
+print(f"Feature importances: {list(gbm.feature_importance())}")
+
+print("Saving model...")
+gbm.save_model(os.path.join(HERE, "model.txt"))
+print("Dumping and loading model with pickle...")
+with open(os.path.join(HERE, "model.pkl"), "wb") as f:
+    pickle.dump(gbm, f)
+with open(os.path.join(HERE, "model.pkl"), "rb") as f:
+    pkl_bst = pickle.load(f)
+y_pred = pkl_bst.predict(X_test, num_iteration=7)
+logloss = float(-np.mean(
+    y_test * np.log(np.clip(y_pred, 1e-15, 1))
+    + (1 - y_test) * np.log(np.clip(1 - y_pred, 1e-15, 1))))
+print(f"The logloss of loaded model's prediction is: {logloss}")
+
+print("Continuing training from the saved model...")
+gbm = lgb.train(params, lgb_train, num_boost_round=10,
+                init_model=os.path.join(HERE, "model.txt"),
+                valid_sets=[lgb_eval])
+
+print("Continuing training with parameter reset...")
+gbm = lgb.train(dict(params, learning_rate=0.02), lgb_train,
+                num_boost_round=10, init_model=gbm,
+                valid_sets=[lgb_eval])
+
+
+# custom objective: log-likelihood loss (same as binary)
+def loglikelihood(preds, train_data):
+    labels = train_data.get_label()
+    preds = 1.0 / (1.0 + np.exp(-preds))
+    grad = preds - labels
+    hess = preds * (1.0 - preds)
+    return grad, hess
+
+
+# custom metric: error rate
+def binary_error(preds, train_data):
+    labels = train_data.get_label()
+    preds = 1.0 / (1.0 + np.exp(-preds))
+    return "error", float(np.mean(labels != (preds > 0.5))), False
+
+
+print("Starting training with custom objective and eval...")
+gbm = lgb.train(dict(params, objective=loglikelihood), lgb_train,
+                num_boost_round=10, feval=binary_error,
+                valid_sets=[lgb_eval])
+print("Finished advanced example.")

@@ -1,0 +1,56 @@
+"""Plotting example (reference:
+examples/python-guide/plot_example.py — metric curve, importance,
+split-value histogram, tree structure). Figures are saved, not shown
+(headless)."""
+import os
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DATA = os.path.join(HERE, os.pardir, "regression")
+
+try:
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+except ImportError:
+    raise SystemExit("matplotlib is required for plot_example.py")
+
+print("Loading data...")
+train = np.loadtxt(os.path.join(DATA, "regression.train"), delimiter="\t")
+test = np.loadtxt(os.path.join(DATA, "regression.test"), delimiter="\t")
+y_train, X_train = train[:, 0], train[:, 1:]
+y_test, X_test = test[:, 0], test[:, 1:]
+
+lgb_train = lgb.Dataset(X_train, label=y_train)
+lgb_eval = lgb.Dataset(X_test, label=y_test, reference=lgb_train)
+
+evals_result = {}
+print("Starting training...")
+gbm = lgb.train({"objective": "regression", "metric": ["l1", "l2"],
+                 "num_leaves": 5, "verbose": 0},
+                lgb_train, num_boost_round=50,
+                valid_sets=[lgb_train, lgb_eval],
+                callbacks=[lgb.record_evaluation(evals_result)])
+
+print("Plotting metrics recorded during training...")
+ax = lgb.plot_metric(evals_result, metric="l1")
+plt.savefig(os.path.join(HERE, "metric.png"))
+
+print("Plotting feature importances...")
+ax = lgb.plot_importance(gbm, max_num_features=10)
+plt.savefig(os.path.join(HERE, "importance.png"))
+
+print("Plotting split value histogram...")
+ax = lgb.plot_split_value_histogram(gbm, feature=2, bins="auto")
+plt.savefig(os.path.join(HERE, "split_hist.png"))
+
+print("Plotting 3rd tree...")
+try:
+    ax = lgb.plot_tree(gbm, tree_index=2, figsize=(15, 8))
+    plt.savefig(os.path.join(HERE, "tree.png"))
+except ImportError as e:
+    print(f"skipping tree plot ({e})")
+print("Figures written next to this script.")

@@ -1,0 +1,58 @@
+"""scikit-learn API example (reference:
+examples/python-guide/sklearn_example.py — fit/predict, feature
+importances, GridSearchCV)."""
+import os
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DATA = os.path.join(HERE, os.pardir, "regression")
+
+print("Loading data...")
+train = np.loadtxt(os.path.join(DATA, "regression.train"), delimiter="\t")
+test = np.loadtxt(os.path.join(DATA, "regression.test"), delimiter="\t")
+y_train, X_train = train[:, 0], train[:, 1:]
+y_test, X_test = test[:, 0], test[:, 1:]
+
+print("Starting training...")
+gbm = lgb.LGBMRegressor(num_leaves=31, learning_rate=0.05,
+                        n_estimators=40)
+gbm.fit(X_train, y_train, eval_set=[(X_test, y_test)],
+        eval_metric="l1",
+        callbacks=[lgb.early_stopping(stopping_rounds=5)])
+
+print("Starting predicting...")
+y_pred = gbm.predict(X_test, num_iteration=gbm.best_iteration_)
+rmse = float(np.sqrt(np.mean((y_pred - y_test) ** 2)))
+print(f"The RMSE of prediction is: {rmse}")
+
+print(f"Feature importances: {list(gbm.feature_importances_)}")
+
+# self-defined eval metric: root mean squared logarithmic error
+def rmsle(y_true, y_pred):
+    return ("RMSLE",
+            float(np.sqrt(np.mean(
+                (np.log1p(np.abs(y_pred)) - np.log1p(np.abs(y_true)))
+                ** 2))),
+            False)
+
+
+print("Starting training with custom eval function...")
+gbm = lgb.LGBMRegressor(num_leaves=31, learning_rate=0.05,
+                        n_estimators=20)
+gbm.fit(X_train, y_train, eval_set=[(X_test, y_test)],
+        eval_metric=rmsle,
+        callbacks=[lgb.early_stopping(stopping_rounds=5)])
+
+try:
+    from sklearn.model_selection import GridSearchCV
+    print("Grid searching...")
+    estimator = lgb.LGBMRegressor(num_leaves=31)
+    param_grid = {"learning_rate": [0.01, 0.1], "n_estimators": [20, 40]}
+    gbm = GridSearchCV(estimator, param_grid, cv=3)
+    gbm.fit(X_train, y_train)
+    print(f"Best parameters found by grid search are: {gbm.best_params_}")
+except ImportError:
+    print("scikit-learn not available; skipping GridSearchCV")

@@ -148,12 +148,114 @@ def _sidecar(data_path: str, kind: str):
     return vals.astype(np.int32) if kind == "query" else vals
 
 
+def _machine_list(config) -> List[str]:
+    """Resolve the cluster machine list (reference: Config::Set reads
+    ``machines`` or ``machine_list_filename``,
+    src/network/linkers_socket.cpp:81)."""
+    if config.machines:
+        return [m.strip() for m in str(config.machines).split(",")
+                if m.strip()]
+    if config.machine_list_filename:
+        with open(config.machine_list_filename) as f:
+            return [ln.strip().replace(" ", ":") for ln in f
+                    if ln.strip()]
+    return []
+
+
+def _distributed_train(config, params) -> int:
+    """CLI multi-machine training (reference: Application::Application
+    calls Network::Init when num_machines > 1,
+    src/application/application.cpp:46 + config.h network section).
+
+    Rank resolution mirrors the socket linker: each machine appears in
+    the shared machine list and identifies itself by its
+    ``local_listen_port`` (reference matches local IPs,
+    linkers_socket.cpp:166 — ports alone also disambiguate the
+    single-host fake cluster the reference uses in its own distributed
+    tests, tests/distributed/_test_distributed.py). The first machine
+    is the jax.distributed coordinator."""
+    machines = _machine_list(config)
+    if len(machines) != config.num_machines:
+        log.fatal("num_machines=%d but the machine list has %d entries"
+                  % (config.num_machines, len(machines)))
+    port = int(config.local_listen_port)
+    entries = []
+    for m in machines:
+        ip, sep, p = m.rpartition(":")
+        if not sep or not p.isdigit():
+            log.fatal("machine list entry '%s' is not ip:port (or "
+                      "'ip port' in the list file)" % m)
+        entries.append((ip, int(p)))
+
+    def _ip_is_local(ip: str) -> bool:
+        import socket
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.bind((ip, 0))     # binds only to locally-owned IPs
+            return True
+        except OSError:
+            return False
+
+    # rank resolution mirrors the socket linker: match local IPs first
+    # (linkers_socket.cpp:166); among local entries (every entry, on a
+    # single-host fake cluster) local_listen_port disambiguates
+    local = [i for i, (ip, _) in enumerate(entries) if _ip_is_local(ip)]
+    if len(local) > 1:
+        local = [i for i in local if entries[i][1] == port]
+    rank = local[0] if len(local) == 1 else None
+    if rank is None:
+        log.fatal("cannot identify this machine in machines=%s (local "
+                  "IP match%s); check the list and local_listen_port=%d"
+                  % (",".join(machines),
+                     " + port" if local == [] else " ambiguous", port))
+    if config.valid:
+        log.warning("valid_data is not evaluated by the distributed CLI "
+                    "path yet; train metrics only")
+    if config.input_model:
+        log.warning("input_model (continued training) is not supported "
+                    "by the distributed CLI path; training from scratch")
+    from .parallel import distributed as dist_mod
+    dist_mod.initialize(coordinator_address="%s:%d" % entries[0],
+                        num_processes=int(config.num_machines),
+                        process_id=rank)
+    import jax
+    X, y, w, g = _load_tabular(config.data, config)
+    g = g if g is not None else _sidecar(config.data, "query")
+    w = w if w is not None else _sidecar(config.data, "weight")
+    if not config.pre_partition:
+        # a shared data file: every machine keeps its rank-strided rows
+        # (reference: pre_partition=false row filtering,
+        # data_parallel_tree_learner semantics in dataset_loader.cpp:240)
+        sel = slice(rank, None, int(config.num_machines))
+        X, y = X[sel], (y[sel] if y is not None else None)
+        w = w[sel] if w is not None else None
+        if g is not None:
+            log.fatal("pre_partition=false cannot row-stride grouped "
+                      "(ranking) data; pre-partition query files per "
+                      "machine")
+    from .parallel import dtrain
+    booster = dtrain.train(params, X, y,
+                           num_boost_round=config.num_iterations,
+                           local_weight=w, local_group=g)
+    out = config.output_model or "LightGBM_model.txt"
+    if rank == 0:
+        booster.save_model(out)
+    log.info("Finished distributed training (rank %d/%d)%s"
+             % (rank, config.num_machines,
+                "; model saved to %s" % out if rank == 0 else ""))
+    jax.distributed.shutdown()
+    return 0
+
+
 def run(argv: Optional[List[str]] = None) -> int:
     """reference: Application::Run (include/LightGBM/application.h:79)."""
     argv = sys.argv[1:] if argv is None else argv
     params = parse_args(argv)
     config = Config.from_params(params)
     task = config.task
+
+    if task == "train" and int(config.num_machines) > 1:
+        return _distributed_train(config, params)
 
     if task == "train":
         X, y, w, g = _load_tabular(config.data, config)

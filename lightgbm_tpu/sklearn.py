@@ -234,8 +234,45 @@ class LGBMModel(_LGBMModelBase):
         y = self._ensure_1d_y(y)
         self._validate_fit_input(X, y, sample_weight)
         params = self._process_params()
+        feval = None
         if eval_metric is not None:
-            params["metric"] = eval_metric
+            metrics = (eval_metric if isinstance(eval_metric, (list, tuple))
+                       else [eval_metric])
+            str_metrics = [m for m in metrics if isinstance(m, str)]
+            fn_metrics = [m for m in metrics if callable(m)]
+            if str_metrics:
+                params["metric"] = str_metrics
+            if fn_metrics:
+                # sklearn-style callables take (y_true, y_pred)
+                # (reference: sklearn.py _EvalFunctionWrapper); adapt to
+                # the engine's feval(preds, eval_data) contract. For
+                # built-in objectives the reference hands the callable
+                # TRANSFORMED predictions (probabilities), raw margins
+                # only under a custom objective — mirror that.
+                obj = params.get("objective", "")
+                if callable(obj):
+                    transform = None
+                elif str(obj) in ("binary", "xentropy", "cross_entropy",
+                                  "cross_entropy_lambda",
+                                  "xentlambda"):
+                    def transform(p):
+                        return 1.0 / (1.0 + np.exp(-p))
+                elif str(obj).startswith(("multiclass", "softmax",
+                                          "ova", "one_vs_all",
+                                          "multiclassova")):
+                    def transform(p):
+                        e = np.exp(p - p.max(axis=-1, keepdims=True))
+                        return e / e.sum(axis=-1, keepdims=True)
+                else:
+                    transform = None
+
+                def _wrap(fn):
+                    def feval_fn(preds, ds):
+                        p = transform(preds) if transform is not None \
+                            else preds
+                        return fn(ds.get_label(), p)
+                    return feval_fn
+                feval = [_wrap(f) for f in fn_metrics]
         if self.class_weight is not None:
             sample_weight = _apply_class_weight(
                 self.class_weight, y, sample_weight)
@@ -263,7 +300,7 @@ class LGBMModel(_LGBMModelBase):
         self._Booster = _train(
             params, train_set, num_boost_round=self.n_estimators,
             valid_sets=valid_sets, valid_names=eval_names,
-            callbacks=callbacks)
+            feval=feval, callbacks=callbacks)
         self._best_iteration = self._Booster.best_iteration
         self._n_features = train_set.num_feature()
         self.n_features_in_ = self._n_features
