@@ -268,6 +268,10 @@ class Config:
     feature_pre_filter: bool = True
     pre_partition: bool = False
     two_round: bool = False
+    # progress-log interval for text loading (config.h:679); accepted
+    # for conf compatibility — the numpy/native-parser loaders finish
+    # in one pass without incremental progress logging
+    file_load_progress_interval_bytes: int = 10 * 1024 * 1024 * 1024
     header: bool = False
     label_column: str = ""
     weight_column: str = ""

@@ -126,11 +126,17 @@ class BinnedDataset:
         for the sample pass; Dataset::PushRow + FinishLoad for the full pass)."""
         is_sparse = hasattr(data, "tocsc")
         if is_sparse:
-            # scipy input stays sparse until binning (reference analogue:
-            # SparseBin, src/io/sparse_bin.hpp — here sparsity is
-            # exploited via per-column binning + EFB bundling instead of
-            # a delta-encoded store)
+            # scipy input stays sparse end-to-end: every per-column pass
+            # is O(nnz), never materializing a dense value column
+            # (reference analogue: SparseBin, src/io/sparse_bin.hpp —
+            # delta-encoded pushes; here CSC slices feed the binner and
+            # EFB bundles the exclusive columns)
             data = data.tocsc()
+            if not data.has_canonical_format:
+                # duplicate (row, col) entries must SUM (dense semantics);
+                # copy first — tocsc() may alias the caller's matrix
+                data = data.copy()
+                data.sum_duplicates()
             if keep_raw_data:
                 log.fatal("keep_raw_data/linear_tree requires dense input")
         else:
@@ -141,11 +147,14 @@ class BinnedDataset:
                 log.fatal("Training data must be 2-dimensional")
         n, num_total_features = data.shape
 
+        def col_nonzero(f: int):
+            """Sparse column f as (row_indices, values) — O(nnz)."""
+            sl = slice(int(data.indptr[f]), int(data.indptr[f + 1]))
+            return data.indices[sl], np.asarray(data.data[sl],
+                                                dtype=np.float64)
+
         def full_col(f: int) -> np.ndarray:
-            if is_sparse:
-                return np.asarray(
-                    data[:, [f]].todense(), dtype=np.float64).ravel()
-            return data[:, f]
+            return data[:, f]   # dense paths only; sparse uses col_nonzero
 
         self = cls()
         self.num_total_features = num_total_features
@@ -202,14 +211,34 @@ class BinnedDataset:
                                 % (config.forcedbins_filename, e))
             mappers: List[BinMapper] = []
             sample_bin_cols: List[np.ndarray] = []
+            sample_cnt_eff = sample_cnt if sample_idx is not None else n
             for f in range(num_total_features):
                 bm = BinMapper()
                 max_bin_f = (max_bin_by_feature[f]
                              if f < len(max_bin_by_feature) else config.max_bin)
-                col = full_col(f)
-                sample_col = col if sample_idx is None else col[sample_idx]
+                if is_sparse:
+                    # feed the binner only the sampled NON-ZERO values;
+                    # total_sample_cnt accounts the zeros (the reference
+                    # samples exactly this way —
+                    # DatasetLoader::SampleTextData keeps non-zeros +
+                    # the global sample count, dataset_loader.cpp:593)
+                    rows, vals = col_nonzero(f)
+                    if sample_idx is not None:
+                        pos = np.searchsorted(sample_idx, rows)
+                        pos_ok = pos < len(sample_idx)
+                        pos_ok[pos_ok] &= (sample_idx[pos[pos_ok]]
+                                           == rows[pos_ok])
+                        sample_col = vals[pos_ok]
+                        sample_rows = pos[pos_ok]
+                    else:
+                        sample_col = vals
+                        sample_rows = rows
+                else:
+                    col = full_col(f)
+                    sample_col = (col if sample_idx is None
+                                  else col[sample_idx])
                 bm.find_bin(
-                    sample_col, total_sample_cnt=len(sample_col),
+                    sample_col, total_sample_cnt=sample_cnt_eff,
                     max_bin=max_bin_f,
                     min_data_in_bin=config.min_data_in_bin,
                     min_split_data=config.min_data_in_leaf,
@@ -221,8 +250,14 @@ class BinnedDataset:
                     forced_upper_bounds=forced_bounds.get(f))
                 mappers.append(bm)
                 if not bm.is_trivial:
-                    sample_bin_cols.append(
-                        bm.value_to_bin(sample_col).astype(np.int32))
+                    if is_sparse:
+                        sb = np.full(sample_cnt_eff, bm.default_bin,
+                                     dtype=np.int32)
+                        sb[sample_rows] = bm.value_to_bin(sample_col)
+                        sample_bin_cols.append(sb)
+                    else:
+                        sample_bin_cols.append(
+                            bm.value_to_bin(sample_col).astype(np.int32))
             self.bin_mappers = [m for m in mappers if not m.is_trivial]
             self.used_feature_map = [i for i, m in enumerate(mappers)
                                      if not m.is_trivial]
@@ -239,23 +274,29 @@ class BinnedDataset:
             if config.enable_bundle and len(self.bin_mappers) > 1:
                 self._find_bundles(sample_bin_cols, config)
 
-        # --- full binning pass ---
+        # --- full binning pass (O(nnz) per column on sparse input) ---
+        def binned_col(j: int) -> np.ndarray:
+            f, bm = self.used_feature_map[j], self.bin_mappers[j]
+            if is_sparse:
+                rows, vals = col_nonzero(f)
+                out = np.full(n, bm.default_bin, dtype=np.int32)
+                out[rows] = bm.value_to_bin(vals)
+                return out
+            return bm.value_to_bin(full_col(f))
+
         if self.bundle is not None:
             from .efb import bundle_columns
             dtype = (np.uint8 if self.bundle.num_bundled_bins <= 256
                      else np.uint16)
             zero_bins = np.asarray([m.default_bin for m in self.bin_mappers],
                                    dtype=np.int32)
-            self.bins = bundle_columns(
-                lambda j: self.bin_mappers[j].value_to_bin(
-                    full_col(self.used_feature_map[j])),
-                self.bundle, zero_bins, n, dtype)
+            self.bins = bundle_columns(binned_col, self.bundle,
+                                       zero_bins, n, dtype)
         else:
             dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
             bins = np.empty((n, len(self.bin_mappers)), dtype=dtype)
-            for j, (f, bm) in enumerate(zip(self.used_feature_map,
-                                            self.bin_mappers)):
-                bins[:, j] = bm.value_to_bin(full_col(f)).astype(dtype)
+            for j in range(len(self.bin_mappers)):
+                bins[:, j] = binned_col(j).astype(dtype)
             self.bins = bins
         if keep_raw_data:
             self.raw_data = data

@@ -99,12 +99,27 @@ class DataParallelTreeLearner(CapabilityMixin):
             log.fatal("Cannot train without features")
         self.N = N
         n_dev = mesh.devices.size
-        # pad rows to a devices multiple; pad rows carry leaf -1 / gh 0
+        # pad rows to a devices multiple; pad rows carry leaf -1 / gh 0.
+        # Shards are materialized one at a time through
+        # make_array_from_callback — a host-side concatenate of the full
+        # padded matrix would double peak host memory at Higgs scale
         self.R = -(-N // n_dev) * n_dev
-        pad = np.zeros((self.R - N, C), dtype=cols_host.dtype)
-        bins_host = np.concatenate([cols_host, pad], axis=0)
-        self.bins = jax.device_put(
-            bins_host, NamedSharding(mesh, P(self.axis, None)))
+        sharding = NamedSharding(mesh, P(self.axis, None))
+
+        def _shard(index):
+            rs = index[0]
+            start = rs.start or 0
+            stop = rs.stop if rs.stop is not None else self.R
+            avail = max(0, min(N, stop) - start)
+            if avail == stop - start:
+                return cols_host[start:stop]
+            shard = np.zeros((stop - start, C), dtype=cols_host.dtype)
+            if avail > 0:
+                shard[:avail] = cols_host[start:start + avail]
+            return shard
+
+        self.bins = jax.make_array_from_callback(
+            (self.R, C), sharding, _shard)
         self._init_cegb(config)
         self._init_monotone(config)
 

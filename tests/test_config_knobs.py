@@ -110,3 +110,87 @@ def test_hist_backend_and_f64_warns(capsys):
                   lgb.Dataset(X, label=y), num_boost_round=3)
     assert "jax_enable_x64" in capsys.readouterr().err
     np.testing.assert_allclose(c.predict(X), a.predict(X), rtol=1e-12)
+
+
+# ----------------------------------------------------------------------
+# Full reference-catalog audit (round-5 verdict item 8): every
+# user-facing field of /root/reference/include/LightGBM/config.h must be
+# a Config field and be accepted by from_params; the accepted-no-op /
+# n/a-by-design subset is pinned to docs/CONFIG_AUDIT.md.
+_REFERENCE_FIELDS = [
+    "alpha", "auc_mu_weights", "bagging_fraction", "bagging_freq",
+    "bagging_seed", "bin_construct_sample_cnt", "boost_from_average", "boosting",
+    "cat_l2", "cat_smooth", "categorical_feature", "cegb_penalty_feature_coupled",
+    "cegb_penalty_feature_lazy", "cegb_penalty_split", "cegb_tradeoff", "convert_model",
+    "convert_model_language", "data", "data_random_seed", "data_sample_strategy",
+    "deterministic", "device_type", "drop_rate", "drop_seed",
+    "early_stopping_round", "enable_bundle", "eval_at", "extra_seed",
+    "extra_trees", "fair_c", "feature_contri", "feature_fraction",
+    "feature_fraction_bynode", "feature_fraction_seed", "feature_pre_filter", "file_load_progress_interval_bytes",
+    "first_metric_only", "force_col_wise", "force_row_wise", "forcedbins_filename",
+    "forcedsplits_filename", "gpu_device_id", "gpu_platform_id", "gpu_use_dp",
+    "group_column", "header", "histogram_pool_size", "ignore_column",
+    "input_model", "interaction_constraints", "is_enable_sparse", "is_provide_training_metric",
+    "is_unbalance", "label_column", "label_gain", "lambda_l1",
+    "lambda_l2", "lambdarank_norm", "lambdarank_truncation_level", "learning_rate",
+    "linear_lambda", "linear_tree", "local_listen_port", "machine_list_filename",
+    "machines", "max_bin", "max_bin_by_feature", "max_cat_threshold",
+    "max_cat_to_onehot", "max_delta_step", "max_depth", "max_drop",
+    "metric", "metric_freq", "min_data_in_bin", "min_data_in_leaf",
+    "min_data_per_group", "min_gain_to_split", "min_sum_hessian_in_leaf", "monotone_constraints",
+    "monotone_constraints_method", "monotone_penalty", "multi_error_top_k", "neg_bagging_fraction",
+    "num_class", "num_gpu", "num_iteration_predict", "num_iterations",
+    "num_leaves", "num_machines", "num_threads", "objective",
+    "objective_seed", "other_rate", "output_model", "output_result",
+    "parser_config_file", "path_smooth", "poisson_max_delta_step", "pos_bagging_fraction",
+    "pre_partition", "precise_float_parser", "pred_early_stop", "pred_early_stop_freq",
+    "pred_early_stop_margin", "predict_contrib", "predict_disable_shape_check", "predict_leaf_index",
+    "predict_raw_score", "refit_decay_rate", "reg_sqrt", "save_binary",
+    "saved_feature_importance_type", "scale_pos_weight", "seed", "sigmoid",
+    "skip_drop", "snapshot_freq", "start_iteration_predict", "time_out",
+    "top_k", "top_rate", "tree_learner", "tweedie_variance_power",
+    "two_round", "uniform_drop", "use_missing", "valid",
+    "verbosity", "weight_column", "xgboost_dart_mode", "zero_as_missing",
+]
+
+_ACCEPTED_NOOP = {
+    "file_load_progress_interval_bytes",
+    "force_col_wise",
+    "force_row_wise",
+    "gpu_device_id",
+    "gpu_platform_id",
+    "histogram_pool_size",
+    "is_enable_sparse",
+    "num_gpu",
+    "num_threads",
+    "parser_config_file",
+    "precise_float_parser",
+    "time_out",
+    "two_round",
+}
+
+
+@pytest.mark.parametrize("field", _REFERENCE_FIELDS)
+def test_reference_catalog(field):
+    from lightgbm_tpu.config import Config
+    c = Config()
+    assert hasattr(c, field), "reference config field missing: " + field
+    # from_params must accept the field (round-trips the default)
+    default = getattr(c, field)
+    c2 = Config.from_params({field: default})
+    assert hasattr(c2, field)
+
+
+def test_catalog_matches_audit_doc():
+    """Every accepted-no-op field is documented, and no documented row
+    drifted out of the catalog."""
+    import os
+    doc = os.path.join(os.path.dirname(__file__), os.pardir, "docs",
+                       "CONFIG_AUDIT.md")
+    text = open(doc).read()
+    for f in _REFERENCE_FIELDS:
+        assert "| `%s` |" % f in text, f
+    for f in _ACCEPTED_NOOP:
+        row = [ln for ln in text.splitlines()
+               if ln.startswith("| `%s` |" % f)][0]
+        assert "implemented" not in row, row

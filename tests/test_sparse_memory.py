@@ -1,0 +1,81 @@
+"""Sparse ingestion stays O(nnz): no dense value matrix is ever
+materialized (reference analogue: SparseBin keeps Bosch/Allstate-class
+data compact, src/io/sparse_bin.hpp; round-4 verdict item 6)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def test_sparse_sampled_binning_matches_dense():
+    """The sparse sampling pass feeds only sampled non-zeros +
+    total_sample_cnt; bin boundaries must equal the dense path's."""
+    sp = pytest.importorskip("scipy.sparse")
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(2)
+    n = 3000
+    X = np.zeros((n, 12))
+    mask = rng.rand(n, 12) < 0.08
+    X[mask] = rng.randn(int(mask.sum())) * 3.0
+    y = (X[:, 0] + X[:, 1] - X[:, 2] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 20, "bin_construct_sample_cnt": 800}
+    bd = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
+    bs = lgb.train(params, lgb.Dataset(sp.csr_matrix(X), label=y),
+                   num_boost_round=8)
+    np.testing.assert_allclose(bd.predict(X), bs.predict(X),
+                               rtol=1e-6, atol=1e-7)
+
+
+_RSS_CHILD = r"""
+import numpy as np
+import scipy.sparse as sp
+
+def vm_peak_kb():
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmHWM"):
+                return int(line.split()[1])
+    return 0
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+
+rng = np.random.RandomState(0)
+n, F, density = 400_000, 300, 0.02
+nnz = int(n * F * density)
+rows = rng.randint(0, n, nnz)
+cols = rng.randint(0, F, nnz)
+vals = rng.randn(nnz).astype(np.float32)
+X = sp.csr_matrix((vals, (rows, cols)), shape=(n, F))
+y = rng.rand(n)
+base = vm_peak_kb()
+cfg = Config.from_params({"verbosity": -1})
+ds = BinnedDataset.from_matrix(X, cfg, label=y)
+peak = vm_peak_kb()
+print("DELTA_MB", (peak - base) / 1024.0, "bins_mb",
+      ds.bins.nbytes / 2**20, "groups", ds.bins.shape[1])
+"""
+
+
+@pytest.mark.slow
+def test_sparse_peak_memory_stays_near_csr_size(tmp_path):
+    """400k x 300 at 2% density: dense f64 staging would be ~960 MB; the
+    O(nnz) path must keep the binning-pass peak within a small multiple
+    of the CSR (~28 MB) + output bundle matrix."""
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, "-c", _RSS_CHILD], env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    line = [ln for ln in r.stdout.splitlines()
+            if ln.startswith("DELTA_MB")][0]
+    delta_mb = float(line.split()[1])
+    # dense f64 staging alone would add ~960 MB; allow the binned
+    # output (<=120 MB un-bundled worst case) + transients
+    assert delta_mb < 400, line
