@@ -193,9 +193,11 @@ def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
                  booster.objective)[0]
 
     # record which histogram kernel actually ran (the Pallas path
-    # self-probes and may silently fall back to the einsum scan)
+    # self-probes and may fall back; CPU auto-selects the segment-sum
+    # scatter path)
     from lightgbm_tpu.ops.histogram import _use_pallas
-    kernel = "pallas" if _use_pallas() else "einsum"
+    kernel = ("pallas" if _use_pallas() else
+              "scatter" if jax.default_backend() == "cpu" else "einsum")
 
     rows_note = ("" if n_rows == HIGGS_ROWS
                  else " [NOT full Higgs scale; vs_baseline reported 0]")

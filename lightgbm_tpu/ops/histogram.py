@@ -45,12 +45,7 @@ def resolve_hist_impl(backend: str = "auto",
     reference's gpu_use_dp, docs/GPU-Performance.rst). f64 accumulation
     requires jax_enable_x64 and disables the Pallas kernel (f32-only)."""
     backend = (backend or "auto").lower()
-    if backend == "scatter":
-        from ..utils import log
-        log.warning("hist_backend=scatter is a CPU concept; using the "
-                    "one-hot contraction instead")
-        backend = "onehot"
-    if backend not in ("auto", "onehot", "pallas"):
+    if backend not in ("auto", "onehot", "pallas", "scatter"):
         from ..utils import log
         log.warning("unknown hist_backend=%s; using auto" % backend)
         backend = "auto"
@@ -122,6 +117,26 @@ def _use_pallas() -> bool:
         log.warning("Pallas histogram unavailable (%s); using the "
                     "einsum fallback" % type(e).__name__)
         return False
+
+
+def _segment_histogram(bins: jnp.ndarray, gh: jnp.ndarray,
+                       num_bins: int) -> jnp.ndarray:
+    """Scatter-add formulation via a flat segment-sum — the direct
+    analogue of the reference's CPU hot loop (dense_bin.hpp:99
+    ``ConstructHistogramInner``: per row, hist[bin] += (g, h)). On CPU
+    this is ~20x less work than the one-hot contraction (O(S·F·C)
+    updates vs O(S·F·B·C) FLOPs); on TPU the MXU prefers the matmul
+    forms, so this path is selected only for CPU backends."""
+    S, F = bins.shape
+    C = gh.shape[1]
+    acc_dtype = (jnp.float64 if gh.dtype == jnp.float64
+                 else jnp.float32)
+    flat = (jnp.arange(F, dtype=jnp.int32)[None, :] * num_bins
+            + bins.astype(jnp.int32)).reshape(-1)            # [S*F]
+    vals = jnp.broadcast_to(
+        gh.astype(acc_dtype)[:, None, :], (S, F, C)).reshape(-1, C)
+    out = jax.ops.segment_sum(vals, flat, num_segments=F * num_bins)
+    return out.reshape(F, num_bins, C).astype(jnp.float32)
 
 
 def _tile_histogram(bins_tile: jnp.ndarray, gh_tile: jnp.ndarray,
@@ -269,6 +284,9 @@ def build_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
             os.environ["LGBM_TPU_NO_PALLAS"] = "1"
     if f64:
         gh = gh.astype(jnp.float64)
+    if backend == "scatter" or (backend == "auto"
+                                and jax.default_backend() == "cpu"):
+        return _segment_histogram(bins, gh, num_bins)
     acc_dtype = jnp.float64 if f64 else jnp.float32
     if S <= row_tile:
         return _tile_histogram(bins, gh, num_bins).astype(jnp.float32)
