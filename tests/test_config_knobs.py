@@ -94,22 +94,21 @@ def test_predict_shape_check():
 
 def test_hist_backend_and_f64_warns(capsys):
     X, y = _data()
-    # hist_backend=onehot trains identically (pallas is TPU-only here
-    # anyway); scatter warns and degrades
+    # hist_backend=onehot and scatter (a real backend since round 5 —
+    # the reference CPU loop's shape) train to matching predictions
     a = lgb.train({"objective": "regression", "verbosity": -1,
                    "hist_backend": "onehot"},
                   lgb.Dataset(X, label=y), num_boost_round=3)
     b = lgb.train({"objective": "regression", "verbosity": 1,
                    "hist_backend": "scatter"},
                   lgb.Dataset(X, label=y), num_boost_round=3)
-    np.testing.assert_allclose(a.predict(X), b.predict(X), rtol=1e-12)
-    assert "hist_backend=scatter" in capsys.readouterr().err
+    np.testing.assert_allclose(a.predict(X), b.predict(X), rtol=1e-6)
     # f64 without x64 warns and stays f32
     c = lgb.train({"objective": "regression", "verbosity": 1,
                    "tpu_use_f64_hist": True},
                   lgb.Dataset(X, label=y), num_boost_round=3)
     assert "jax_enable_x64" in capsys.readouterr().err
-    np.testing.assert_allclose(c.predict(X), a.predict(X), rtol=1e-12)
+    np.testing.assert_allclose(c.predict(X), a.predict(X), rtol=1e-6)
 
 
 # ----------------------------------------------------------------------
