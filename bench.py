@@ -152,6 +152,11 @@ def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
         "objective": "binary", "num_leaves": 255, "max_bin": 255,
         "learning_rate": 0.1, "metric": "auc", "verbosity": -1,
         "min_data_in_leaf": 100, "num_iterations": n_iters,
+        # whole-tree-per-dispatch learner: ONE host read-back per tree
+        # (the serial learner's ~254 per-split syncs would each pay the
+        # ~27 ms tunnel latency); on one chip this runs on a 1-device
+        # mesh and keeps the Pallas histogram kernel
+        "tree_learner": os.environ.get("BENCH_TREE_LEARNER", "data"),
     }
     cfg = Config.from_params(params)
     t0 = time.time()

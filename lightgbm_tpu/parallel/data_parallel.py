@@ -234,12 +234,18 @@ class DataParallelTreeLearner(CapabilityMixin):
         """Globally-summed per-feature [F, B, 4] histogram. Bundled:
         only the [G, Bg, 4] bundle histogram crosses devices, then the
         per-feature unpack runs replicated (``totals`` reconstructs the
-        zero-bin rows of bundled features, io/efb.py)."""
+        zero-bin rows of bundled features, io/efb.py).
+
+        pallas_ok only on a 1-device mesh: pallas_call has no SPMD
+        partitioning rule, so with real sharding GSPMD would all-gather
+        the bins; unsharded, the kernel is safe (and is the fast path
+        for single-chip tree_learner=data runs)."""
+        p_ok = self.mesh.devices.size == 1
         if not self._bundled:
-            h = build_histogram(bins, gh, self.B, pallas_ok=False,
+            h = build_histogram(bins, gh, self.B, pallas_ok=p_ok,
                                 hist_impl=self._hist_impl)
             return jax.lax.with_sharding_constraint(h, self.hist_sharding)
-        bh = build_histogram(bins, gh, self.Bg, pallas_ok=False,
+        bh = build_histogram(bins, gh, self.Bg, pallas_ok=p_ok,
                              hist_impl=self._hist_impl)
         bh = jax.lax.with_sharding_constraint(bh, self.rep_sharding)
         return unpack_bundle_histogram(bh, self._btab.gidx_g,

@@ -41,7 +41,8 @@ def main():
     iters = int(sys.argv[2]) if len(sys.argv) > 2 else 50
     ref_bin = sys.argv[3] if len(sys.argv) > 3 else "/tmp/refsrc/lightgbm"
     n_test = min(500_000, rows // 4)
-    work = os.environ.get("PARITY_WORKDIR", "/tmp/parity_run")
+    work = os.environ.get("PARITY_WORKDIR",
+                          "/tmp/parity_run_%d" % rows)
     os.makedirs(work, exist_ok=True)
 
     from bench import make_higgs_like
