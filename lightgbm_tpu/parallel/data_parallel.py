@@ -332,12 +332,60 @@ class DataParallelTreeLearner(CapabilityMixin):
             jnp.where(smaller_is_left, rec.left_count, rec.right_count),
             jnp.where(smaller_is_left, rec.left_total_count,
                       rec.right_total_count)])
-        hist_small = self._mesh_hist(bins, state.gh * small_mask[:, None],
-                                     small_totals)
+        if self.mesh.devices.size == 1:
+            # single-chip fast path: compact the child's rows first so
+            # histogram cost tracks the child size, not the full row
+            # space (the reference's DataPartition + per-leaf iterators,
+            # data_partition.hpp:21; the CUDA learner's equivalent win
+            # is cuda_data_partition's leaf-indexed row sets)
+            hist_small = self._compact_child_hist(
+                bins, state.gh, leaf_of_row == small_id, small_totals)
+        else:
+            hist_small = self._mesh_hist(
+                bins, state.gh * small_mask[:, None], small_totals)
         hist_large = subtract_histogram(state.hists[leaf], hist_small)
         hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
         hist_right = jnp.where(smaller_is_left, hist_large, hist_small)
         return hist_left, hist_right, mask_left, mask_right
+
+    def _compact_child_hist(self, bins, gh, mask, totals):
+        """Gather the smaller child's rows into a static power-ladder
+        bucket (``lax.switch`` over compiled sizes) and histogram only
+        those. A leaf-wise tree's total smaller-child row count is
+        ~N·log2(L)/2, so this cuts per-tree histogram work by ~50x at
+        255 leaves vs masked full-row scans — the single-chip analogue
+        of the reference's per-leaf row iterators
+        (data_partition.hpp:119 GetIndexOnLeaf). The scatter/gather
+        compaction itself is O(R) bandwidth, far below the histogram's
+        O(S·F) compute. Sharded meshes keep the masked full-row scan
+        (compaction across shards would need an all-to-all; each shard
+        already scans only its local rows)."""
+        R = bins.shape[0]
+        sizes = []
+        s = -(-R // 2)
+        while s > 16384:
+            sizes.append(s)
+            s = -(-s // 4)
+        sizes.append(s)
+        count = totals[3].astype(jnp.int32)     # rows on the leaf
+        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        rows = jnp.arange(R, dtype=jnp.int32)
+
+        def make_branch(S):
+            def branch(_):
+                idx = jnp.zeros((S,), dtype=jnp.int32)
+                idx = idx.at[jnp.where(mask, pos, S)].set(rows,
+                                                          mode="drop")
+                keep = (jnp.arange(S, dtype=jnp.int32)
+                        < count)[:, None]
+                return self._mesh_hist(bins[idx],
+                                       gh[idx] * keep, totals)
+            return branch
+
+        k = jnp.clip(
+            jnp.sum(jnp.asarray(sizes, dtype=jnp.int32) >= count) - 1,
+            0, len(sizes) - 1)
+        return jax.lax.switch(k, [make_branch(S) for S in sizes], 0)
 
     def _update_hist_store(self, state, leaf, new_leaf, hist_left,
                            hist_right, valid):
