@@ -33,6 +33,30 @@ def mesh8():
 
 
 class TestDataParallel:
+    def test_one_device_mesh_compaction_matches_serial(self):
+        """The 1-device mesh path compacts the smaller child's rows
+        before histogramming (lax.switch bucket ladder); the tree must
+        equal the serial learner's exactly at tie-free scale."""
+        X, grad, hess = _data(n=1500)
+        cfg = Config.from_params({"num_leaves": 31, "min_data_in_leaf": 5,
+                                  "verbosity": -1})
+        ds = BinnedDataset.from_matrix(X, cfg)
+        serial = SerialTreeLearner(cfg, ds)
+        dist = DataParallelTreeLearner(cfg, ds, make_mesh(1))
+        t1, p1 = serial.train(jnp.asarray(grad), jnp.asarray(hess))
+        t2, p2 = dist.train(jnp.asarray(grad), jnp.asarray(hess))
+        assert t1.num_leaves == t2.num_leaves
+        np.testing.assert_array_equal(
+            t1.split_feature[:t1.num_internal],
+            t2.split_feature[:t2.num_internal])
+        np.testing.assert_array_equal(
+            t1.threshold_in_bin[:t1.num_internal],
+            t2.threshold_in_bin[:t2.num_internal])
+        np.testing.assert_allclose(
+            t1.leaf_value[:t1.num_leaves],
+            t2.leaf_value[:t2.num_leaves], rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+
     def test_matches_serial(self, mesh8):
         X, grad, hess = _data()
         cfg = Config.from_params({"num_leaves": 15, "min_data_in_leaf": 5,
