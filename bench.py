@@ -231,6 +231,11 @@ def _run_escalating() -> dict:
     _enable_compile_cache()
     platform = jax.devices()[0].platform
     if platform == "cpu":
+        if "BENCH_ROWS" not in os.environ:
+            # a full-Higgs CPU run takes hours on one core; cap the
+            # default so a CPU-only environment still reports a number
+            os.environ["BENCH_ROWS"] = "200000"
+            os.environ.setdefault("BENCH_ITERS", "120")
         return run_bench()
     target = int(os.environ.get("BENCH_ROWS", HIGGS_ROWS))
     budget = float(os.environ.get("BENCH_TIME_BUDGET", 2400))
