@@ -155,8 +155,12 @@ def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
         # whole-tree-per-dispatch learner: ONE host read-back per tree
         # (the serial learner's ~254 per-split syncs would each pay the
         # ~27 ms tunnel latency); on one chip this runs on a 1-device
-        # mesh and keeps the Pallas histogram kernel
+        # mesh and keeps the Pallas histogram kernel + the smaller-child
+        # row compaction. Pin the mesh to 1 device: a virtual-8-device
+        # CPU env would otherwise shard the bench onto GSPMD paths that
+        # share the same physical core.
         "tree_learner": os.environ.get("BENCH_TREE_LEARNER", "data"),
+        "mesh_shape": os.environ.get("BENCH_MESH", "data=1"),
     }
     cfg = Config.from_params(params)
     t0 = time.time()
