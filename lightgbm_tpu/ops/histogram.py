@@ -255,7 +255,8 @@ def build_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
     backend, f64 = hist_impl
     S, F = bins.shape
     C = gh.shape[1]
-    want_pallas = (pallas_ok and not f64 and backend != "onehot"
+    want_pallas = (pallas_ok and not f64
+                   and backend not in ("onehot", "scatter")
                    and S >= PALLAS_ROW_TILE and C <= 8
                    and _pallas_fits(F, num_bins, C))
     if backend == "pallas" and not (want_pallas and _use_pallas()):

@@ -250,21 +250,21 @@ class LGBMModel(_LGBMModelBase):
                 # TRANSFORMED predictions (probabilities), raw margins
                 # only under a custom objective — mirror that.
                 obj = params.get("objective", "")
-                if callable(obj):
-                    transform = None
-                elif str(obj) in ("binary", "xentropy", "cross_entropy",
-                                  "cross_entropy_lambda",
-                                  "xentlambda"):
-                    def transform(p):
-                        return 1.0 / (1.0 + np.exp(-p))
-                elif str(obj).startswith(("multiclass", "softmax",
-                                          "ova", "one_vs_all",
-                                          "multiclassova")):
-                    def transform(p):
-                        e = np.exp(p - p.max(axis=-1, keepdims=True))
-                        return e / e.sum(axis=-1, keepdims=True)
-                else:
-                    transform = None
+                transform = None
+                if obj and not callable(obj):
+                    # use the objective's OWN output transform — the
+                    # same one predict()/predict_proba apply — so the
+                    # callable sees the model's real predictions
+                    # (per-class sigmoid for multiclassova, configured
+                    # sigmoid for binary, exp for poisson-family, ...)
+                    from .config import Config as _Cfg
+                    from .objective import create_objective
+                    try:
+                        _o = create_objective(str(obj),
+                                              _Cfg.from_params(params))
+                        transform = _o.convert_output
+                    except Exception:
+                        transform = None
 
                 def _wrap(fn):
                     def feval_fn(preds, ds):
