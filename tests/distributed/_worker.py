@@ -8,11 +8,25 @@ import sys
 import numpy as np
 
 
+def worker_params(mode: str, n: int) -> dict:
+    """Shared by the worker and the single-process comparison side."""
+    params = {"num_leaves": 15, "min_data_in_leaf": 5,
+              "bin_construct_sample_cnt": n, "verbosity": -1}
+    if mode == "mono_advanced":
+        params.update({"monotone_constraints": [1, -1, 0, 0, 0, 0],
+                       "monotone_constraints_method": "advanced"})
+    elif mode == "mono_intermediate":
+        params.update({"monotone_constraints": [1, -1, 0, 0, 0, 0],
+                       "monotone_constraints_method": "intermediate"})
+    return params
+
+
 def main() -> None:
     rank = int(sys.argv[1])
     nproc = int(sys.argv[2])
     port = sys.argv[3]
     out = sys.argv[4]
+    mode = sys.argv[5] if len(sys.argv) > 5 else "plain"
 
     import jax
     jax.distributed.initialize("127.0.0.1:%s" % port, nproc, rank)
@@ -27,9 +41,7 @@ def main() -> None:
     X = rng.randn(n, f)
     y = (X[:, 0] + 0.5 * X[:, 1] ** 2 > 0.3)
     lo, hi = rank * (n // nproc), (rank + 1) * (n // nproc)
-    cfg = Config.from_params({"num_leaves": 15, "min_data_in_leaf": 5,
-                              "bin_construct_sample_cnt": n,
-                              "verbosity": -1})
+    cfg = Config.from_params(worker_params(mode, n))
     ds = distributed_binned_dataset(X[lo:hi], cfg)
     mesh = global_mesh()
     lrn = DistributedDataParallelLearner(cfg, ds, mesh)

@@ -163,3 +163,62 @@ def test_two_process_full_boosting_matches_single(tmp_path, mode):
         acc = (np.argmax(w[0]["pred"], axis=1) == y).mean()
         assert acc > 0.8
         assert int(w[0]["n_trees"][0]) == 24  # 8 iters x 3 classes
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["mono_intermediate", "mono_advanced"])
+def test_two_process_monotone_matches_single_process(tmp_path, mode):
+    """The capability matrix holds for the MULTI-PROCESS learner too:
+    host-stepwise monotone drivers (intermediate + advanced) replicate
+    deterministically across ranks and equal the single-process mesh
+    learner's tree (reference contract: every feature under every
+    tree_learner)."""
+    nproc = 2
+    port = _free_port()
+    outs = [str(tmp_path / ("w%d.npz" % r)) for r in range(nproc)]
+    procs = [subprocess.Popen(
+        [sys.executable, _WORKER, str(r), str(nproc), str(port),
+         outs[r], mode],
+        env=_worker_env(2), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+        for r in range(nproc)]
+    logs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        logs.append(out)
+    for r, p in enumerate(procs):
+        assert p.returncode == 0, "worker %d failed:\n%s" % (r, logs[r])
+    w = [np.load(o) for o in outs]
+    np.testing.assert_array_equal(w[0]["split_feature"],
+                                  w[1]["split_feature"])
+    np.testing.assert_array_equal(w[0]["threshold_in_bin"],
+                                  w[1]["threshold_in_bin"])
+
+    import sys as _sys
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                     "distributed"))
+    from _worker import worker_params
+    import jax.numpy as jnp
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.parallel import DataParallelTreeLearner, make_mesh
+    rng = np.random.RandomState(0)
+    n, f = 800, 6
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 > 0.3)
+    cfg = Config.from_params(worker_params(mode, n))
+    ds = BinnedDataset.from_matrix(X, cfg)
+    single = DataParallelTreeLearner(cfg, ds, make_mesh(2))
+    grad = jnp.asarray(np.where(y, -0.5, 0.5).astype(np.float32))
+    hess = jnp.full(n, 0.25, dtype=jnp.float32)
+    tree, _ = single.train(grad, hess)
+    assert int(w[0]["num_leaves"][0]) == tree.num_leaves
+    np.testing.assert_array_equal(w[0]["split_feature"],
+                                  tree.split_feature[:tree.num_internal])
+    np.testing.assert_array_equal(
+        w[0]["threshold_in_bin"],
+        tree.threshold_in_bin[:tree.num_internal])
