@@ -18,6 +18,9 @@ def worker_params(mode: str, n: int) -> dict:
     elif mode == "mono_intermediate":
         params.update({"monotone_constraints": [1, -1, 0, 0, 0, 0],
                        "monotone_constraints_method": "intermediate"})
+    elif mode == "cegb":
+        params.update({"cegb_tradeoff": 0.9,
+                       "cegb_penalty_split": 1e-4})
     return params
 
 
