@@ -167,9 +167,10 @@ def test_two_process_full_boosting_matches_single(tmp_path, mode):
 
 @pytest.mark.slow
 @pytest.mark.parametrize("mode", ["mono_intermediate", "mono_advanced", "cegb"])
-def test_two_process_monotone_matches_single_process(tmp_path, mode):
+def test_two_process_capabilities_match_single_process(tmp_path, mode):
     """The capability matrix holds for the MULTI-PROCESS learner too:
-    host-stepwise monotone drivers (intermediate + advanced) replicate
+    host-stepwise capability drivers (monotone intermediate/advanced,
+    CEGB) replicate
     deterministically across ranks and equal the single-process mesh
     learner's tree (reference contract: every feature under every
     tree_learner)."""
