@@ -58,6 +58,15 @@ def sample_case(rng):
             rng.choice(["basic", "intermediate", "advanced"]))
     if rng.rand() < 0.25:
         params["extra_trees"] = True
+    if rng.rand() < 0.2:
+        params["boosting"] = str(rng.choice(["dart", "rf"]))
+        if params["boosting"] == "rf":
+            params["bagging_fraction"] = 0.7
+            params["bagging_freq"] = 1
+    elif rng.rand() < 0.2:
+        params["data_sample_strategy"] = "goss"
+        params.pop("bagging_fraction", None)
+        params.pop("bagging_freq", None)
     n_cat = int(rng.choice([0, 0, 1, 2]))
     use_missing = rng.rand() < 0.3
     return params, n, f, n_cat, use_missing
@@ -119,7 +128,42 @@ def run_case(i, seed, ref_bin, workdir):
     err = float(np.max(np.abs(via_ref - ours_cmp)))
     if not np.isfinite(err) or err > 1e-9:
         return False, "interchange mismatch max|diff|=%g" % err, params
-    return True, "interchange max|diff|=%.1e" % err, params
+
+    # reverse direction: the REFERENCE trains on the same data/params;
+    # we load its model file and must predict bit-identically
+    train_tsv = os.path.join(d, "train.tsv")
+    np.savetxt(train_tsv, np.column_stack([y, X]), delimiter="\t",
+               fmt="%.10g")
+    args = [ref_bin, "task=train", "data=" + train_tsv,
+            "output_model=" + os.path.join(d, "ref_model.txt"),
+            "num_trees=8"]
+    for k, v in params.items():
+        if isinstance(v, list):
+            v = ",".join(str(x) for x in v)
+        elif isinstance(v, bool):
+            v = "true" if v else "false"
+        args.append("%s=%s" % (k, v))
+    if n_cat:
+        args.append("categorical_feature=" +
+                    ",".join(str(c) for c in range(n_cat)))
+    r = subprocess.run(args, capture_output=True, text=True)
+    if r.returncode != 0:
+        return False, "reference train failed: " \
+            + (r.stdout + r.stderr)[-400:], params
+    bst2 = lgb.Booster(model_file=os.path.join(d, "ref_model.txt"))
+    ours2 = bst2.predict(Xte)
+    r = subprocess.run(
+        [ref_bin, "task=predict", "data=" + test_tsv,
+         "input_model=" + os.path.join(d, "ref_model.txt"),
+         "output_result=" + os.path.join(d, "preds2.txt")],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        return False, "reference self-predict failed", params
+    ref2 = np.loadtxt(os.path.join(d, "preds2.txt")).reshape(ours2.shape)
+    err2 = float(np.max(np.abs(ref2 - ours2)))
+    if not np.isfinite(err2) or err2 > 1e-9:
+        return False, "reverse mismatch max|diff|=%g" % err2, params
+    return True, "fwd %.1e rev %.1e" % (err, err2), params
 
 
 def main():
