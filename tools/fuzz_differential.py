@@ -24,7 +24,9 @@ import lightgbm_tpu as lgb  # noqa: E402
 
 
 def sample_case(rng):
-    objective = rng.choice(["binary", "regression", "multiclass"])
+    objective = rng.choice(["binary", "regression", "multiclass",
+                            "lambdarank", "poisson", "quantile",
+                            "xentropy"])
     params = {
         "objective": str(objective),
         "num_leaves": int(rng.choice([4, 15, 31, 63])),
@@ -51,7 +53,9 @@ def sample_case(rng):
         params["max_depth"] = int(rng.choice([3, 5]))
     if rng.rand() < 0.2:
         params["min_gain_to_split"] = 0.01
-    if rng.rand() < 0.25 and objective != "multiclass":
+    if rng.rand() < 0.25 and objective in ("binary", "regression",
+                                           "poisson", "quantile",
+                                           "xentropy"):
         mc = [int(v) for v in rng.choice([-1, 0, 1], size=f)]
         params["monotone_constraints"] = mc
         params["monotone_constraints_method"] = str(
@@ -80,12 +84,18 @@ def gen_data(rng, n, f, n_cat, use_missing, objective, num_class=3):
         X[rng.rand(n, f) < 0.1] = np.nan
     base = np.where(np.isnan(X[:, -1]), 0.0, X[:, -1]) \
         + 0.5 * np.where(np.isnan(X[:, 0]), 0.0, X[:, 0])
-    if objective == "binary":
+    if objective in ("binary", "xentropy"):
         y = (base + 0.3 * rng.randn(n) > 0).astype(float)
     elif objective == "multiclass":
         y = np.clip(np.digitize(base + 0.3 * rng.randn(n),
                                 [-0.5, 0.5]), 0, num_class - 1).astype(
             float)
+    elif objective == "poisson":
+        y = rng.poisson(np.exp(np.clip(base, -2, 2))).astype(float)
+    elif objective == "lambdarank":
+        # graded relevance within fixed-size queries
+        y = np.clip(np.digitize(base + 0.3 * rng.randn(n),
+                                [-0.8, 0.0, 0.8]), 0, 3).astype(float)
     else:
         y = base + 0.2 * rng.randn(n)
     return X, y
@@ -99,8 +109,19 @@ def run_case(i, seed, ref_bin, workdir):
     Xte = gen_data(rng, 200, f, n_cat, use_missing,
                    params["objective"])[0]
     cat = list(range(n_cat)) if n_cat else "auto"
-    bst = lgb.train(dict(params), lgb.Dataset(X, label=y,
-                                              categorical_feature=cat),
+    is_rank = params["objective"] == "lambdarank"
+    group = None
+    if is_rank:
+        per_q = 20
+        n = (n // per_q) * per_q
+        X, y = X[:n], y[:n]
+        group = np.full(n // per_q, per_q, dtype=np.int32)
+    weight = None
+    if rng.rand() < 0.3 and not is_rank:
+        weight = (0.25 + rng.rand(len(y)) * 2).round(3)
+    bst = lgb.train(dict(params),
+                    lgb.Dataset(X, label=y, weight=weight, group=group,
+                                categorical_feature=cat),
                     num_boost_round=8)
     ours = bst.predict(Xte)
 
@@ -134,6 +155,10 @@ def run_case(i, seed, ref_bin, workdir):
     train_tsv = os.path.join(d, "train.tsv")
     np.savetxt(train_tsv, np.column_stack([y, X]), delimiter="\t",
                fmt="%.10g")
+    if group is not None:
+        np.savetxt(train_tsv + ".query", group, fmt="%d")
+    if weight is not None:
+        np.savetxt(train_tsv + ".weight", weight, fmt="%.10g")
     args = [ref_bin, "task=train", "data=" + train_tsv,
             "output_model=" + os.path.join(d, "ref_model.txt"),
             "num_trees=8"]
