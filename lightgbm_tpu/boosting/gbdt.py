@@ -161,6 +161,16 @@ class GBDT:
             self.objective.init(train_data.metadata, train_data.num_data)
             self.num_tree_per_iteration = \
                 self.objective.num_model_per_iteration
+            if (self.objective.is_renew_tree_output
+                    and config.monotone_constraints
+                    and any(int(v) != 0
+                            for v in config.monotone_constraints)):
+                # reference contract (gbdt.cpp:94): leaf-output renewal
+                # (l1/quantile/mape/huber/fair) overwrites the clamped
+                # outputs, so monotonicity cannot be honored
+                log.fatal("Cannot use ``monotone_constraints`` in %s "
+                          "objective, please disable it."
+                          % config.objective)
         else:
             self.num_tree_per_iteration = self.num_class
         self.num_data = train_data.num_data

@@ -115,3 +115,24 @@ def test_cli_predict_pads_narrow_libsvm(tmp_path):
     preds = np.loadtxt(out)
     assert preds.shape == (50,)
     assert np.isfinite(preds).all()
+
+
+def test_renew_objective_rejects_monotone_constraints():
+    """Leaf-output-renewing objectives (l1/quantile/mape) overwrite the
+    clamped outputs, so the reference refuses the combination
+    (gbdt.cpp:94) — and so do we (found by tools/fuzz_differential.py:
+    the reference rejected a config we silently accepted)."""
+    from lightgbm_tpu.utils.log import LightGBMError
+    rng = np.random.RandomState(0)
+    X = rng.rand(200, 3)
+    y = X[:, 0] + 0.1 * rng.randn(200)
+    for obj in ("quantile", "l1", "mape"):
+        with pytest.raises(LightGBMError, match="monotone_constraints"):
+            lgb.train({"objective": obj, "verbosity": -1,
+                       "monotone_constraints": [1, 0, 0]},
+                      lgb.Dataset(X, label=np.abs(y)),
+                      num_boost_round=2)
+    # l2 regression still accepts them
+    lgb.train({"objective": "regression", "verbosity": -1,
+               "monotone_constraints": [1, 0, 0]},
+              lgb.Dataset(X, label=y), num_boost_round=2)
