@@ -56,7 +56,8 @@ def sample_case(rng):
     # renew-tree-output objectives (l1/quantile/mape) reject monotone
     # constraints — reference contract, gbdt.cpp:94
     if rng.rand() < 0.25 and objective in ("binary", "regression",
-                                           "poisson", "xentropy"):
+                                           "poisson", "xentropy",
+                                           "multiclass", "lambdarank"):
         mc = [int(v) for v in rng.choice([-1, 0, 1], size=f)]
         params["monotone_constraints"] = mc
         params["monotone_constraints_method"] = str(
