@@ -23,24 +23,34 @@ _LIB: Optional[ctypes.CDLL] = None
 _LIB_FAILED = False
 
 
+def compile_and_load(src_name: str, so_name: str) -> ctypes.CDLL:
+    """Compile a C++ source in this directory into a cached shared
+    object (rebuilt when the source is newer) and dlopen it. Shared by
+    every native component; raises on a missing/broken toolchain (each
+    caller decides how to degrade). The .tmp rename keeps a concurrent
+    builder in another process from dlopening a half-written file."""
+    src = os.path.join(_HERE, src_name)
+    so = os.path.join(_HERE, so_name)
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)):
+        tmp = so + ".%d.tmp" % os.getpid()
+        subprocess.check_call(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+             "-o", tmp, src],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        os.replace(tmp, so)
+    return ctypes.CDLL(so)
+
+
 def _build_and_load() -> Optional[ctypes.CDLL]:
-    """Compile parser.cpp into _parser.so (once; cached on disk) and
-    load it. Returns None when no working toolchain is available."""
+    """Load the parser library via compile_and_load, binding signatures.
+    Returns None when no working toolchain is available."""
     global _LIB, _LIB_FAILED
     with _LOCK:
         if _LIB is not None or _LIB_FAILED:
             return _LIB
-        src = os.path.join(_HERE, "parser.cpp")
-        so = os.path.join(_HERE, "_parser.so")
         try:
-            if (not os.path.exists(so)
-                    or os.path.getmtime(so) < os.path.getmtime(src)):
-                subprocess.check_call(
-                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                     "-o", so + ".tmp", src],
-                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-                os.replace(so + ".tmp", so)
-            lib = ctypes.CDLL(so)
+            lib = compile_and_load("parser.cpp", "_parser.so")
             lib.ParseDense.restype = ctypes.c_int
             lib.ParseDense.argtypes = [
                 ctypes.c_char_p, ctypes.c_char, ctypes.c_int,

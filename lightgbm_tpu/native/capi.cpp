@@ -1,0 +1,886 @@
+// C-ABI inference runtime over the v3 model text format.
+//
+// External-engine counterpart of the reference's C API
+// (reference: include/LightGBM/c_api.h, src/c_api.cpp): a C/C++/R/Java
+// host can load a model file produced by this framework OR by the
+// reference (the text formats interchange bit-exactly,
+// tests/test_reference_parity.py) and run prediction with no Python
+// runtime at all. Function names and signatures follow the reference's
+// predict-side surface so existing C clients re-link against this
+// library unchanged; training-side entry points live in the Python
+// runtime by design (docs/PARITY.md layer 8).
+//
+// Semantics mirrored here (and cross-checked by tests/test_c_api.py
+// against the Python predictor bit-for-bit):
+//  - numerical/categorical decisions incl. missing-value routing
+//    (reference: include/LightGBM/tree.h:133 Predict,
+//    NumericalDecision/CategoricalDecision; missing bits 2-3 of
+//    decision_type, default-left bit 1, categorical bit 0)
+//  - categorical bitset membership (reference: common.h FindInBitset)
+//  - piecewise-linear leaves with NaN fallback to the constant
+//    (reference: src/treelearner/linear_tree_learner.cpp predict)
+//  - objective raw->output transforms (reference:
+//    ObjectiveFunction::ConvertOutput per objective file)
+//  - random-forest score averaging (average_output header flag)
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 -o _capi.so capi.cpp
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#define LGBM_EXPORT extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+thread_local std::string g_last_error = "everything is fine";
+
+constexpr double kZeroThreshold = 1e-35;  // io/binning.py:25
+constexpr int kCategoricalMask = 1;
+constexpr int kDefaultLeftMask = 2;
+enum MissingType { kMissingNone = 0, kMissingZero = 1, kMissingNaN = 2 };
+
+// predict_type values (reference: c_api.h C_API_PREDICT_*)
+enum { kPredictNormal = 0, kPredictRaw = 1, kPredictLeaf = 2,
+       kPredictContrib = 3 };
+// data_type values (reference: c_api.h C_API_DTYPE_*)
+enum { kDtypeF32 = 0, kDtypeF64 = 1, kDtypeI32 = 2, kDtypeI64 = 3 };
+
+enum Transform { kIdentity, kSigmoid, kSoftmax, kExp, kSignSquare,
+                 kLog1pExp };
+
+struct CTree {
+  int num_leaves = 1;
+  std::vector<int> split_feature, left_child, right_child;
+  std::vector<double> threshold, leaf_value;
+  std::vector<int8_t> decision_type;
+  std::vector<int> threshold_in_bin;  // cat-split index for cat nodes
+  std::vector<int64_t> cat_boundaries;
+  std::vector<uint32_t> cat_threshold;
+  // data-coverage weights for SHAP (reference: tree.h data_count(node))
+  std::vector<double> leaf_count, internal_count;
+  // prepared once at load time (PrepareShap): clamped coverage weights
+  // (mirroring models/shap.py _node_count's max(count, 1)), the
+  // cover-weighted expected value, and the flat-path capacity
+  std::vector<double> shap_leaf_w, shap_node_w;
+  double shap_expected = 0.0;
+  size_t shap_path_capacity = 2;
+  // linear leaves
+  bool is_linear = false;
+  std::vector<double> leaf_const;
+  std::vector<std::vector<int>> leaf_features;
+  std::vector<std::vector<double>> leaf_coeff;
+
+  bool CatContains(int cat_idx, double fval) const {
+    if (std::isnan(fval)) return false;
+    int iv = static_cast<int>(fval);
+    if (iv < 0) return false;
+    int64_t lo = cat_boundaries[cat_idx];
+    int64_t hi = cat_boundaries[cat_idx + 1];
+    int64_t word = lo + iv / 32;
+    if (word >= hi) return false;
+    return (cat_threshold[word] >> (iv % 32)) & 1u;
+  }
+
+  // returns ~leaf walk; row is a dense feature vector (NaN = missing)
+  int PredictLeaf(const double* row, int ncol) const {
+    if (num_leaves <= 1) return 0;
+    int node = 0;
+    while (node >= 0) {
+      int f = split_feature[node];
+      double fval = (f < ncol) ? row[f] : std::nan("");
+      int dt = decision_type[node];
+      bool go_left;
+      if (dt & kCategoricalMask) {
+        go_left = CatContains(threshold_in_bin[node], fval);
+      } else {
+        int missing = (dt >> 2) & 3;
+        bool default_left = dt & kDefaultLeftMask;
+        bool is_nan = std::isnan(fval);
+        double v = (is_nan && missing != kMissingNaN) ? 0.0 : fval;
+        if (missing == kMissingZero && std::fabs(v) <= kZeroThreshold) {
+          go_left = default_left;
+        } else if (missing == kMissingNaN && is_nan) {
+          go_left = default_left;
+        } else {
+          go_left = v <= threshold[node];
+        }
+      }
+      node = go_left ? left_child[node] : right_child[node];
+    }
+    return ~node;
+  }
+
+  void PrepareShap() {
+    int ni = num_leaves - 1;
+    if ((int)leaf_count.size() >= num_leaves) {
+      shap_leaf_w.resize(num_leaves);
+      for (int l = 0; l < num_leaves; ++l)
+        shap_leaf_w[l] = std::max(leaf_count[l], 1.0);
+    } else {
+      shap_leaf_w.assign(std::max(num_leaves, 1), 1.0);
+    }
+    if (ni <= 0) {
+      shap_expected = leaf_value.empty() ? 0.0 : leaf_value[0];
+      return;
+    }
+    if ((int)internal_count.size() >= ni) {
+      shap_node_w.resize(ni);
+      for (int j = 0; j < ni; ++j)
+        shap_node_w[j] = std::max(internal_count[j], 1.0);
+    } else {
+      // bottom-up sums of leaf mass (a child internal node always has
+      // a larger index than its parent — creation order)
+      shap_node_w.assign(ni, 0.0);
+      for (int j = ni - 1; j >= 0; --j) {
+        int l = left_child[j], r = right_child[j];
+        shap_node_w[j] = (l >= 0 ? shap_node_w[l] : shap_leaf_w[~l]) +
+                         (r >= 0 ? shap_node_w[r] : shap_leaf_w[~r]);
+      }
+    }
+    // expected value: RAW-count weighted leaf mean, unweighted when the
+    // counts are absent/zero (models/shap.py _expected_value)
+    double total = 0.0, acc = 0.0, plain = 0.0;
+    for (int l = 0; l < num_leaves; ++l) {
+      double c = (int)leaf_count.size() > l ? leaf_count[l] : 0.0;
+      total += c;
+      acc += c * leaf_value[l];
+      plain += leaf_value[l];
+    }
+    shap_expected = total > 0 ? acc / total : plain / num_leaves;
+    // flat path buffer: level d's segment starts after sum_{k<d}(k+1)
+    // elements (reference TreeSHAP's parent_unique_path advance)
+    std::vector<int> depth_of(ni, 0);
+    int max_depth = 0;
+    for (int j = 0; j < ni; ++j) {
+      for (int child : {left_child[j], right_child[j]}) {
+        int d = depth_of[j] + 1;
+        if (child >= 0) depth_of[child] = d;
+        if (d > max_depth) max_depth = d;
+      }
+    }
+    size_t D = max_depth + 2;
+    shap_path_capacity = (D + 1) * (D + 2) / 2 + D + 2;
+  }
+
+  double PredictValue(const double* row, int ncol) const {
+    int leaf = PredictLeaf(row, ncol);
+    if (is_linear) {
+      // unfitted leaves (no features) and NaN rows keep the constant
+      // leaf_value — NOT leaf_const, which misses later AddBias shifts
+      // (reference: linear predict falls back to the leaf output)
+      const auto& feats = leaf_features[leaf];
+      if (!feats.empty()) {
+        double out = leaf_const[leaf];
+        bool ok = true;
+        for (size_t j = 0; j < feats.size(); ++j) {
+          double fv = (feats[j] < ncol) ? row[feats[j]] : std::nan("");
+          if (std::isnan(fv)) { ok = false; break; }
+          out += leaf_coeff[leaf][j] * fv;
+        }
+        if (ok) return out;
+      }
+    }
+    return leaf_value[leaf];
+  }
+};
+
+template <typename T>
+std::vector<T> ParseArray(const std::string& s) {
+  std::vector<T> out;
+  std::istringstream is(s);
+  if constexpr (std::is_same_v<T, int8_t>) {
+    int v;  // int8 must parse as integer text, not raw char
+    while (is >> v) out.push_back(static_cast<int8_t>(v));
+  } else {
+    T v;
+    while (is >> v) out.push_back(v);
+  }
+  return out;
+}
+
+struct CBooster {
+  int num_class = 1;
+  int num_tree_per_iteration = 1;
+  int max_feature_idx = 0;
+  bool average_output = false;
+  Transform transform = kIdentity;
+  double sigmoid = 1.0;
+  std::vector<std::string> feature_names;
+  std::vector<CTree> trees;
+  std::string raw_model;  // original text, for SaveModel round-trip
+
+  int NumIterations() const {
+    return static_cast<int>(trees.size()) / num_tree_per_iteration;
+  }
+
+  // trees [start_iteration, start_iteration + num_iteration) in
+  // iteration units; num_iteration <= 0 means "to the end"
+  void UsedRange(int start_iteration, int num_iteration,
+                 int* t0, int* t1) const {
+    int total = NumIterations();
+    int s = std::max(start_iteration, 0);
+    int n = (num_iteration <= 0) ? total - s
+                                 : std::min(num_iteration, total - s);
+    n = std::max(n, 0);
+    *t0 = s * num_tree_per_iteration;
+    *t1 = (s + n) * num_tree_per_iteration;
+  }
+
+  void PredictRawRow(const double* row, int ncol, int t0, int t1,
+                     double* out) const {
+    for (int k = 0; k < num_class; ++k) out[k] = 0.0;
+    for (int i = t0; i < t1; ++i) {
+      out[i % num_tree_per_iteration] += trees[i].PredictValue(row, ncol);
+    }
+    if (average_output && t1 > t0) {
+      double denom = double(t1 - t0) / num_tree_per_iteration;
+      for (int k = 0; k < num_class; ++k) out[k] /= denom;
+    }
+  }
+
+  void ApplyTransform(double* out) const {
+    switch (transform) {
+      case kIdentity:
+        break;
+      case kSigmoid:
+        for (int k = 0; k < num_class; ++k)
+          out[k] = 1.0 / (1.0 + std::exp(-sigmoid * out[k]));
+        break;
+      case kSoftmax: {
+        double m = out[0];
+        for (int k = 1; k < num_class; ++k) m = std::max(m, out[k]);
+        double sum = 0.0;
+        for (int k = 0; k < num_class; ++k) {
+          out[k] = std::exp(out[k] - m);
+          sum += out[k];
+        }
+        for (int k = 0; k < num_class; ++k) out[k] /= sum;
+        break;
+      }
+      case kExp:
+        for (int k = 0; k < num_class; ++k) out[k] = std::exp(out[k]);
+        break;
+      case kSignSquare:
+        for (int k = 0; k < num_class; ++k)
+          out[k] = (out[k] < 0 ? -1.0 : 1.0) * out[k] * out[k];
+        break;
+      case kLog1pExp:
+        for (int k = 0; k < num_class; ++k)
+          out[k] = std::log1p(std::exp(out[k]));
+        break;
+    }
+  }
+};
+
+bool ParseTree(const std::map<std::string, std::string>& kv, CTree* t,
+               std::string* err) {
+  auto get = [&](const char* k) -> const std::string* {
+    auto it = kv.find(k);
+    return it == kv.end() ? nullptr : &it->second;
+  };
+  const std::string* nl = get("num_leaves");
+  if (!nl) { *err = "tree block missing num_leaves"; return false; }
+  t->num_leaves = std::atoi(nl->c_str());
+  if (t->num_leaves <= 1) {
+    t->leaf_value = {get("leaf_value") ? std::atof(get("leaf_value")->c_str())
+                                       : 0.0};
+    t->num_leaves = 1;
+  } else {
+    int ni = t->num_leaves - 1;
+    for (const char* k : {"split_feature", "threshold", "decision_type",
+                          "left_child", "right_child", "leaf_value"}) {
+      if (!get(k)) {
+        *err = std::string("tree block missing ") + k;
+        return false;
+      }
+    }
+    t->split_feature = ParseArray<int>(*get("split_feature"));
+    t->threshold = ParseArray<double>(*get("threshold"));
+    t->decision_type = ParseArray<int8_t>(*get("decision_type"));
+    t->left_child = ParseArray<int>(*get("left_child"));
+    t->right_child = ParseArray<int>(*get("right_child"));
+    t->leaf_value = ParseArray<double>(*get("leaf_value"));
+    if ((int)t->split_feature.size() < ni ||
+        (int)t->threshold.size() < ni ||
+        (int)t->decision_type.size() < ni ||
+        (int)t->left_child.size() < ni ||
+        (int)t->right_child.size() < ni ||
+        (int)t->leaf_value.size() < t->num_leaves) {
+      *err = "tree block has truncated arrays";
+      return false;
+    }
+    for (int j = 0; j < ni; ++j) {
+      // child pointers: >=0 internal node index, <0 encodes leaf ~idx
+      // internal children must have a LARGER index than the parent
+      // (creation order, tree.h Split numbering) — also rules out
+      // cycles that would hang PredictLeaf's walk
+      if (t->left_child[j] >= ni || t->left_child[j] < -t->num_leaves ||
+          t->right_child[j] >= ni || t->right_child[j] < -t->num_leaves ||
+          (t->left_child[j] >= 0 && t->left_child[j] <= j) ||
+          (t->right_child[j] >= 0 && t->right_child[j] <= j) ||
+          t->split_feature[j] < 0) {
+        *err = "tree block has out-of-range node indices";
+        return false;
+      }
+    }
+    if (get("leaf_count"))
+      t->leaf_count = ParseArray<double>(*get("leaf_count"));
+    if (get("internal_count"))
+      t->internal_count = ParseArray<double>(*get("internal_count"));
+    // cat nodes keep the cat-split index in `threshold`
+    t->threshold_in_bin.assign(ni, 0);
+    if (get("cat_boundaries")) {
+      t->cat_boundaries = ParseArray<int64_t>(*get("cat_boundaries"));
+      if (get("cat_threshold"))
+        t->cat_threshold = ParseArray<uint32_t>(*get("cat_threshold"));
+      for (size_t k = 1; k < t->cat_boundaries.size(); ++k) {
+        if (t->cat_boundaries[k] < t->cat_boundaries[k - 1] ||
+            t->cat_boundaries[k] > (int64_t)t->cat_threshold.size()) {
+          *err = "tree block has inconsistent cat_boundaries";
+          return false;
+        }
+      }
+    }
+    for (int j = 0; j < ni; ++j) {
+      if (t->decision_type[j] & kCategoricalMask) {
+        int ci = static_cast<int>(t->threshold[j]);
+        if (ci < 0 || ci + 1 >= (int)t->cat_boundaries.size()) {
+          *err = "tree block has categorical node without bitset";
+          return false;
+        }
+        t->threshold_in_bin[j] = ci;
+      }
+    }
+  }
+  const std::string* lin = get("is_linear");
+  if (lin && std::atoi(lin->c_str())) {
+    if (!get("leaf_const")) {
+      *err = "linear tree block missing leaf_const";
+      return false;
+    }
+    t->is_linear = true;
+    t->leaf_const = ParseArray<double>(*get("leaf_const"));
+    if ((int)t->leaf_const.size() < t->num_leaves) {
+      *err = "linear tree block has truncated leaf_const";
+      return false;
+    }
+    std::vector<int> nfeat = get("num_features")
+        ? ParseArray<int>(*get("num_features")) : std::vector<int>();
+    std::vector<int> flat_f = get("leaf_features")
+        ? ParseArray<int>(*get("leaf_features")) : std::vector<int>();
+    std::vector<double> flat_c = get("leaf_coeff")
+        ? ParseArray<double>(*get("leaf_coeff")) : std::vector<double>();
+    size_t pos = 0;
+    for (int c : nfeat) {
+      if (c < 0 || pos + c > flat_f.size() || pos + c > flat_c.size()) {
+        *err = "linear tree block has truncated leaf features";
+        return false;
+      }
+      t->leaf_features.emplace_back(flat_f.begin() + pos,
+                                    flat_f.begin() + pos + c);
+      t->leaf_coeff.emplace_back(flat_c.begin() + pos,
+                                 flat_c.begin() + pos + c);
+      pos += c;
+    }
+    t->leaf_features.resize(t->num_leaves);
+    t->leaf_coeff.resize(t->num_leaves);
+  }
+  return true;
+}
+
+bool SetObjective(const std::string& spec, CBooster* b, std::string* err) {
+  std::istringstream is(spec);
+  std::string name, tok;
+  is >> name;
+  double sigmoid = 1.0;
+  while (is >> tok) {
+    if (tok.rfind("sigmoid:", 0) == 0)
+      sigmoid = std::atof(tok.c_str() + 8);
+    // num_class:/alpha:/etc. don't affect ConvertOutput
+  }
+  b->sigmoid = sigmoid;
+  if (name == "binary" || name == "cross_entropy" ||
+      name == "multiclassova" || name == "xentropy") {
+    b->transform = kSigmoid;
+  } else if (name == "multiclass" || name == "softmax") {
+    b->transform = kSoftmax;
+  } else if (name == "poisson" || name == "gamma" || name == "tweedie") {
+    b->transform = kExp;
+  } else if (name == "cross_entropy_lambda" || name == "xentlambda") {
+    b->transform = kLog1pExp;
+  } else if (name == "regression" && spec.find("sqrt") != std::string::npos) {
+    b->transform = kSignSquare;
+  } else {
+    b->transform = kIdentity;  // l2/l1/huber/fair/quantile/mape/ranking
+  }
+  (void)err;
+  return true;
+}
+
+CBooster* LoadFromString(const std::string& s, std::string* err) {
+  auto b = std::make_unique<CBooster>();
+  b->raw_model = s;
+  std::istringstream is(s);
+  std::string line;
+  auto getline_crlf = [&](std::string& out) -> bool {
+    if (!std::getline(is, out)) return false;
+    if (!out.empty() && out.back() == '\r') out.pop_back();
+    return true;
+  };
+  // header until the first Tree= block
+  while (getline_crlf(line)) {
+    if (line.rfind("Tree=", 0) == 0) break;
+    if (line == "average_output") { b->average_output = true; continue; }
+    auto eq = line.find('=');
+    if (eq == std::string::npos) continue;
+    std::string k = line.substr(0, eq), v = line.substr(eq + 1);
+    if (k == "num_class") b->num_class = std::atoi(v.c_str());
+    else if (k == "num_tree_per_iteration")
+      b->num_tree_per_iteration = std::atoi(v.c_str());
+    else if (k == "max_feature_idx") b->max_feature_idx = std::atoi(v.c_str());
+    else if (k == "objective") {
+      if (!SetObjective(v, b.get(), err)) return nullptr;
+    } else if (k == "feature_names") {
+      std::istringstream ns(v);
+      std::string n;
+      while (ns >> n) b->feature_names.push_back(n);
+    }
+  }
+  if (line.rfind("Tree=", 0) != 0) {
+    *err = "no Tree= blocks found (not a model file?)";
+    return nullptr;
+  }
+  // tree blocks: key=value lines until blank/next Tree=/end of trees
+  std::map<std::string, std::string> kv;
+  auto flush = [&]() -> bool {
+    if (kv.empty()) return true;
+    CTree t;
+    if (!ParseTree(kv, &t, err)) return false;
+    // feature indices size the caller's contrib buffer
+    // (max_feature_idx + 2 per class) — an index past the header's
+    // bound would write out of that buffer in the SHAP path
+    for (int j = 0; j < t.num_leaves - 1; ++j) {
+      if (t.split_feature[j] > b->max_feature_idx) {
+        *err = "tree split_feature exceeds header max_feature_idx";
+        return false;
+      }
+    }
+    t.PrepareShap();
+    b->trees.push_back(std::move(t));
+    kv.clear();
+    return true;
+  };
+  while (getline_crlf(line)) {
+    if (line.rfind("Tree=", 0) == 0) {
+      if (!flush()) return nullptr;
+      continue;
+    }
+    if (line == "end of trees") break;
+    auto eq = line.find('=');
+    if (eq != std::string::npos)
+      kv[line.substr(0, eq)] = line.substr(eq + 1);
+  }
+  if (!flush()) return nullptr;
+  if (b->trees.empty()) { *err = "model has no trees"; return nullptr; }
+  if (b->num_class < 1) b->num_class = 1;
+  if (b->num_tree_per_iteration < 1) b->num_tree_per_iteration = 1;
+  if (b->num_tree_per_iteration > b->num_class) {
+    // output stride is num_class; a larger ntpi would write past the
+    // caller's buffer in PredictRawRow (out[i % ntpi])
+    *err = "num_tree_per_iteration exceeds num_class";
+    return nullptr;
+  }
+  return b.release();
+}
+
+int64_t PredictOutputLen(const CBooster* b, int64_t nrow, int predict_type,
+                         int t0, int t1) {
+  if (predict_type == kPredictLeaf) return nrow * (t1 - t0);
+  if (predict_type == kPredictContrib)
+    return nrow * b->num_class * (b->max_feature_idx + 2);
+  return nrow * b->num_class;
+}
+
+// SHAP feature contributions via per-leaf path attribution
+// (reference: src/io/tree.cpp TreeSHAP / PredictContrib). Exact
+// TreeSHAP (Lundberg's EXPVALUE recursion over weight-extended paths).
+struct PathElem {
+  int feature_index;
+  double zero_fraction, one_fraction, pweight;
+};
+
+void ExtendPath(PathElem* path, int depth,
+                double zero_fraction, double one_fraction,
+                int feature_index) {
+  path[depth] = {feature_index, zero_fraction, one_fraction,
+                 depth == 0 ? 1.0 : 0.0};
+  for (int i = depth - 1; i >= 0; --i) {
+    path[i + 1].pweight += one_fraction * path[i].pweight * (i + 1)
+                           / double(depth + 1);
+    path[i].pweight = zero_fraction * path[i].pweight * (depth - i)
+                      / double(depth + 1);
+  }
+}
+
+void UnwindPath(PathElem* path, int depth, int index) {
+  double one_fraction = path[index].one_fraction;
+  double zero_fraction = path[index].zero_fraction;
+  double next_one = path[depth].pweight;
+  for (int i = depth - 1; i >= 0; --i) {
+    if (one_fraction != 0) {
+      double tmp = path[i].pweight;
+      path[i].pweight = next_one * (depth + 1)
+                        / (double(i + 1) * one_fraction);
+      next_one = tmp - path[i].pweight * zero_fraction * (depth - i)
+                       / double(depth + 1);
+    } else {
+      path[i].pweight = path[i].pweight * (depth + 1)
+                        / (zero_fraction * (depth - i));
+    }
+  }
+  for (int i = index; i < depth; ++i) {
+    path[i].feature_index = path[i + 1].feature_index;
+    path[i].zero_fraction = path[i + 1].zero_fraction;
+    path[i].one_fraction = path[i + 1].one_fraction;
+  }
+}
+
+double UnwoundPathSum(const PathElem* path, int depth,
+                      int index) {
+  double one_fraction = path[index].one_fraction;
+  double zero_fraction = path[index].zero_fraction;
+  double next_one = path[depth].pweight;
+  double total = 0;
+  for (int i = depth - 1; i >= 0; --i) {
+    if (one_fraction != 0) {
+      double tmp = next_one * (depth + 1)
+                   / (double(i + 1) * one_fraction);
+      total += tmp;
+      next_one = path[i].pweight - tmp * zero_fraction * (depth - i)
+                                   / double(depth + 1);
+    } else if (zero_fraction != 0) {
+      total += (path[i].pweight / zero_fraction)
+               / ((depth - i) / double(depth + 1));
+    }
+  }
+  return total;
+}
+
+struct ShapContext {
+  const CTree* tree;
+  const double* row;
+  int ncol;
+  double* contribs;  // length ncol+1; last = expected value
+  // one flat buffer per predict call; each recursion level claims the
+  // segment after its parent's (reference: src/io/tree.cpp TreeSHAP's
+  // parent_unique_path + unique_depth + 1 advance), so a child's
+  // duplicate-unwind never corrupts the path its parent hands to the
+  // sibling
+  std::vector<PathElem> storage;
+};
+
+double NodeWeight(const ShapContext& ctx, int node) {
+  return node >= 0 ? ctx.tree->shap_node_w[node]
+                   : ctx.tree->shap_leaf_w[~node];
+}
+
+void TreeShapRecurse(ShapContext& ctx, int node, PathElem* parent_path,
+                     int depth, double zero_fraction, double one_fraction,
+                     int parent_feature) {
+  PathElem* path = parent_path + depth + 1;  // fresh copy per level
+  std::copy(parent_path, parent_path + depth + 1, path);
+  ExtendPath(path, depth, zero_fraction, one_fraction, parent_feature);
+  if (node < 0) {  // leaf
+    double v = ctx.tree->leaf_value[~node];
+    for (int i = 1; i <= depth; ++i) {
+      double w = UnwoundPathSum(path, depth, i);
+      ctx.contribs[path[i].feature_index] +=
+          w * (path[i].one_fraction - path[i].zero_fraction) * v;
+    }
+    return;
+  }
+  const CTree* t = ctx.tree;
+  int f = t->split_feature[node];
+  double fval = (f < ctx.ncol) ? ctx.row[f] : std::nan("");
+  int dt = t->decision_type[node];
+  bool go_left;
+  if (dt & kCategoricalMask) {
+    go_left = t->CatContains(t->threshold_in_bin[node], fval);
+  } else {
+    int missing = (dt >> 2) & 3;
+    bool default_left = dt & kDefaultLeftMask;
+    bool is_nan = std::isnan(fval);
+    double v = (is_nan && missing != kMissingNaN) ? 0.0 : fval;
+    if (missing == kMissingZero && std::fabs(v) <= kZeroThreshold)
+      go_left = default_left;
+    else if (missing == kMissingNaN && is_nan)
+      go_left = default_left;
+    else
+      go_left = v <= t->threshold[node];
+  }
+  int hot = go_left ? t->left_child[node] : t->right_child[node];
+  int cold = go_left ? t->right_child[node] : t->left_child[node];
+  double w = NodeWeight(ctx, node);
+  double hot_frac = w > 0 ? NodeWeight(ctx, hot) / w : 0.0;
+  double cold_frac = w > 0 ? NodeWeight(ctx, cold) / w : 0.0;
+  // if this feature is already on the path, undo and merge fractions
+  double incoming_zero = 1.0, incoming_one = 1.0;
+  int path_index = 0;
+  for (; path_index <= depth; ++path_index) {
+    if (path[path_index].feature_index == f) break;
+  }
+  if (path_index != depth + 1) {
+    incoming_zero = path[path_index].zero_fraction;
+    incoming_one = path[path_index].one_fraction;
+    UnwindPath(path, depth, path_index);
+    depth -= 1;
+  }
+  TreeShapRecurse(ctx, hot, path, depth + 1, hot_frac * incoming_zero,
+                  incoming_one, f);
+  TreeShapRecurse(ctx, cold, path, depth + 1, cold_frac * incoming_zero,
+                  0.0, f);
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------
+// exported C surface
+// ---------------------------------------------------------------------
+
+LGBM_EXPORT const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+static int Fail(const std::string& msg) {
+  g_last_error = msg;
+  return -1;
+}
+
+LGBM_EXPORT int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                                int* out_num_iterations,
+                                                void** out) {
+  if (!model_str || !out) return Fail("null argument");
+  std::string err;
+  CBooster* b = LoadFromString(model_str, &err);
+  if (!b) return Fail(err);
+  if (out_num_iterations) *out_num_iterations = b->NumIterations();
+  *out = b;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                                int* out_num_iterations,
+                                                void** out) {
+  if (!filename || !out) return Fail("null argument");
+  std::ifstream f(filename, std::ios::binary);
+  if (!f) return Fail(std::string("cannot open ") + filename);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return LGBM_BoosterLoadModelFromString(ss.str().c_str(),
+                                         out_num_iterations, out);
+}
+
+LGBM_EXPORT int LGBM_BoosterFree(void* handle) {
+  delete static_cast<CBooster*>(handle);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetNumClasses(void* handle, int* out_len) {
+  if (!handle || !out_len) return Fail("null argument");
+  *out_len = static_cast<CBooster*>(handle)->num_class;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetNumFeature(void* handle, int* out_len) {
+  if (!handle || !out_len) return Fail("null argument");
+  *out_len = static_cast<CBooster*>(handle)->max_feature_idx + 1;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetCurrentIteration(void* handle,
+                                                int* out_iteration) {
+  if (!handle || !out_iteration) return Fail("null argument");
+  *out_iteration = static_cast<CBooster*>(handle)->NumIterations();
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterCalcNumPredict(void* handle, int num_row,
+                                           int predict_type,
+                                           int start_iteration,
+                                           int num_iteration,
+                                           int64_t* out_len) {
+  if (!handle || !out_len) return Fail("null argument");
+  auto* b = static_cast<CBooster*>(handle);
+  int t0, t1;
+  b->UsedRange(start_iteration, num_iteration, &t0, &t1);
+  *out_len = PredictOutputLen(b, num_row, predict_type, t0, t1);
+  return 0;
+}
+
+static void PredictRowInto(const CBooster* b, const double* row, int ncol,
+                           int predict_type, int t0, int t1, double* out,
+                           ShapContext* scratch = nullptr) {
+  if (predict_type == kPredictLeaf) {
+    for (int i = t0; i < t1; ++i)
+      out[i - t0] = b->trees[i].PredictLeaf(row, ncol);
+    return;
+  }
+  if (predict_type == kPredictContrib) {
+    int ncontrib = b->max_feature_idx + 2;
+    for (int k = 0; k < b->num_class; ++k)
+      std::memset(out + k * ncontrib, 0, sizeof(double) * ncontrib);
+    ShapContext local;
+    ShapContext& ctx = scratch ? *scratch : local;
+    ctx.row = row;
+    ctx.ncol = ncol;
+    for (int i = t0; i < t1; ++i) {
+      const CTree& t = b->trees[i];
+      double* cls_out = out + (i % b->num_tree_per_iteration) * ncontrib;
+      cls_out[ncontrib - 1] += t.shap_expected;
+      if (t.num_leaves <= 1) continue;
+      ctx.tree = &t;
+      ctx.contribs = cls_out;  // recursion touches feature slots only
+      if (ctx.storage.size() < t.shap_path_capacity)
+        ctx.storage.resize(t.shap_path_capacity);
+      TreeShapRecurse(ctx, 0, ctx.storage.data(), 0, 1.0, 1.0, -1);
+    }
+    return;
+  }
+  // normal / raw
+  b->PredictRawRow(row, ncol, t0, t1, out);
+  if (predict_type == kPredictNormal) b->ApplyTransform(out);
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForMat(
+    void* handle, const void* data, int data_type, int32_t nrow,
+    int32_t ncol, int is_row_major, int predict_type, int start_iteration,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result) {
+  (void)parameter;
+  if (!handle || !data || !out_result) return Fail("null argument");
+  if (data_type != kDtypeF32 && data_type != kDtypeF64)
+    return Fail("data_type must be C_API_DTYPE_FLOAT32/64");
+  auto* b = static_cast<CBooster*>(handle);
+  int t0, t1;
+  b->UsedRange(start_iteration, num_iteration, &t0, &t1);
+  int64_t stride = PredictOutputLen(b, 1, predict_type, t0, t1);
+  std::vector<double> row(ncol);
+  ShapContext scratch;  // reused path storage across rows
+  for (int32_t r = 0; r < nrow; ++r) {
+    for (int32_t c = 0; c < ncol; ++c) {
+      int64_t idx = is_row_major ? int64_t(r) * ncol + c
+                                 : int64_t(c) * nrow + r;
+      row[c] = (data_type == kDtypeF64)
+                   ? static_cast<const double*>(data)[idx]
+                   : static_cast<double>(
+                         static_cast<const float*>(data)[idx]);
+    }
+    PredictRowInto(b, row.data(), ncol, predict_type, t0, t1,
+                   out_result + r * stride, &scratch);
+  }
+  if (out_len) *out_len = nrow * stride;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForCSR(
+    void* handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int start_iteration, int num_iteration, const char* parameter,
+    int64_t* out_len, double* out_result) {
+  (void)parameter;
+  (void)nelem;
+  if (!handle || !indptr || !indices || !data || !out_result)
+    return Fail("null argument");
+  auto* b = static_cast<CBooster*>(handle);
+  int t0, t1;
+  b->UsedRange(start_iteration, num_iteration, &t0, &t1);
+  int64_t stride = PredictOutputLen(b, 1, predict_type, t0, t1);
+  int64_t nrow = nindptr - 1;
+  std::vector<double> row(num_col);
+  ShapContext scratch;  // reused path storage across rows
+  for (int64_t r = 0; r < nrow; ++r) {
+    std::fill(row.begin(), row.end(), 0.0);
+    int64_t lo, hi;
+    if (indptr_type == kDtypeI64) {
+      lo = static_cast<const int64_t*>(indptr)[r];
+      hi = static_cast<const int64_t*>(indptr)[r + 1];
+    } else {
+      lo = static_cast<const int32_t*>(indptr)[r];
+      hi = static_cast<const int32_t*>(indptr)[r + 1];
+    }
+    for (int64_t j = lo; j < hi; ++j) {
+      int32_t c = indices[j];
+      if (c >= 0 && c < num_col)
+        row[c] = (data_type == kDtypeF64)
+                     ? static_cast<const double*>(data)[j]
+                     : static_cast<double>(
+                           static_cast<const float*>(data)[j]);
+    }
+    PredictRowInto(b, row.data(), static_cast<int>(num_col), predict_type,
+                   t0, t1, out_result + r * stride, &scratch);
+  }
+  if (out_len) *out_len = nrow * stride;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterSaveModelToString(
+    void* handle, int start_iteration, int num_iteration,
+    int feature_importance_type, int64_t buffer_len, int64_t* out_len,
+    char* out_str) {
+  (void)feature_importance_type;
+  if (!handle || !out_len) return Fail("null argument");
+  auto* b = static_cast<CBooster*>(handle);
+  if (start_iteration != 0 ||
+      (num_iteration > 0 && num_iteration < b->NumIterations()))
+    return Fail("predict-side C API keeps the loaded model verbatim; "
+                "slice iterations at predict time instead");
+  *out_len = static_cast<int64_t>(b->raw_model.size()) + 1;
+  if (out_str && buffer_len >= *out_len)
+    std::memcpy(out_str, b->raw_model.c_str(), *out_len);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterSaveModel(void* handle, int start_iteration,
+                                      int num_iteration,
+                                      int feature_importance_type,
+                                      const char* filename) {
+  (void)feature_importance_type;
+  if (!handle || !filename) return Fail("null argument");
+  auto* b = static_cast<CBooster*>(handle);
+  if (start_iteration != 0 ||
+      (num_iteration > 0 && num_iteration < b->NumIterations()))
+    return Fail("predict-side C API keeps the loaded model verbatim");
+  std::ofstream f(filename, std::ios::binary);
+  if (!f) return Fail(std::string("cannot write ") + filename);
+  f << b->raw_model;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetFeatureNames(void* handle, int len,
+                                            int* out_len,
+                                            size_t buffer_len,
+                                            size_t* out_buffer_len,
+                                            char** out_strs) {
+  if (!handle || !out_len || !out_buffer_len) return Fail("null argument");
+  auto* b = static_cast<CBooster*>(handle);
+  *out_len = static_cast<int>(b->feature_names.size());
+  size_t longest = 0;
+  for (auto& n : b->feature_names) longest = std::max(longest, n.size() + 1);
+  *out_buffer_len = longest;
+  if (out_strs) {
+    int n = std::min(len, *out_len);
+    for (int i = 0; i < n; ++i) {
+      std::snprintf(out_strs[i], buffer_len, "%s",
+                    b->feature_names[i].c_str());
+    }
+  }
+  return 0;
+}

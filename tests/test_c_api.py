@@ -1,0 +1,230 @@
+"""C API (native inference library) cross-checks.
+
+The C-ABI library (native/capi.cpp, header native/capi.h) is the
+external-engine counterpart of the reference's predict-side C API
+(reference: include/LightGBM/c_api.h, src/c_api.cpp; exercised by the
+reference's own tests through basic.py's ctypes calls). Every test
+trains with the Python runtime, then drives the C library through the
+same ctypes call sequence an R/Java/C host would use and requires
+agreement with the Python predictor.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.native.capi import (
+    C_API_PREDICT_CONTRIB,
+    C_API_PREDICT_LEAF_INDEX,
+    C_API_PREDICT_NORMAL,
+    C_API_PREDICT_RAW_SCORE,
+    NativeBooster,
+    load_lib,
+)
+
+pytestmark = pytest.mark.skipif(load_lib() is None,
+                                reason="no native toolchain")
+
+
+def _train(params, X, y, rounds=15):
+    ds = lgb.Dataset(X, label=y)
+    p = {"verbosity": -1, "min_data_in_leaf": 5}
+    p.update(params)
+    return lgb.train(p, ds, num_boost_round=rounds)
+
+
+@pytest.fixture(scope="module")
+def binary_model():
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 6)
+    y = (X[:, 0] - X[:, 1] + 0.3 * rng.randn(500) > 0).astype(float)
+    bst = _train({"objective": "binary"}, X, y)
+    return bst, X
+
+
+@pytest.mark.parametrize("objective,extra,make_y", [
+    ("binary", {}, lambda X, rng: (X[:, 0] > 0).astype(float)),
+    ("regression", {}, lambda X, rng: X[:, 0] * 2 + X[:, 1]),
+    ("regression", {"reg_sqrt": True},
+     lambda X, rng: np.abs(X[:, 0] * 3)),
+    ("poisson", {}, lambda X, rng: rng.poisson(np.exp(
+        np.clip(X[:, 0], -2, 2))).astype(float)),
+    ("quantile", {"alpha": 0.7}, lambda X, rng: X[:, 0] + rng.randn(
+        len(X)) * 0.1),
+    ("multiclass", {"num_class": 3},
+     lambda X, rng: np.argmax(X[:, :3], axis=1).astype(float)),
+    ("multiclassova", {"num_class": 3},
+     lambda X, rng: np.argmax(X[:, :3], axis=1).astype(float)),
+    ("cross_entropy", {}, lambda X, rng: 1.0 / (1 + np.exp(-X[:, 0]))),
+])
+def test_predict_matches_python(objective, extra, make_y):
+    rng = np.random.RandomState(7)
+    X = rng.randn(400, 5)
+    y = make_y(X, rng)
+    bst = _train(dict({"objective": objective}, **extra), X, y, rounds=12)
+    nb = NativeBooster(model_str=bst.model_to_string())
+    Xt = rng.randn(80, 5)
+    for pt, kwargs in ((C_API_PREDICT_NORMAL, {}),
+                       (C_API_PREDICT_RAW_SCORE, {"raw_score": True})):
+        ours = np.asarray(bst.predict(Xt, **kwargs))
+        theirs = nb.predict(Xt, predict_type=pt)
+        np.testing.assert_allclose(
+            theirs.reshape(ours.shape), ours, rtol=1e-12, atol=1e-12,
+            err_msg="%s predict_type=%d" % (objective, pt))
+
+
+def test_metadata(binary_model):
+    bst, X = binary_model
+    nb = NativeBooster(model_str=bst.model_to_string())
+    assert nb.num_classes == 1
+    assert nb.num_features == 6
+    assert nb.num_iterations == 15
+    assert nb.feature_names() == ["Column_%d" % i for i in range(6)]
+
+
+def test_leaf_index_matches(binary_model):
+    bst, X = binary_model
+    nb = NativeBooster(model_str=bst.model_to_string())
+    ours = np.asarray(bst.predict(X[:50], pred_leaf=True))
+    theirs = nb.predict(X[:50], predict_type=C_API_PREDICT_LEAF_INDEX)
+    np.testing.assert_array_equal(theirs.astype(np.int64),
+                                  ours.reshape(theirs.shape))
+
+
+def test_contrib_matches_python(binary_model):
+    bst, X = binary_model
+    nb = NativeBooster(model_str=bst.model_to_string())
+    ours = np.asarray(bst.predict(X[:40], pred_contrib=True))
+    theirs = nb.predict(X[:40], predict_type=C_API_PREDICT_CONTRIB)
+    np.testing.assert_allclose(theirs.reshape(ours.shape), ours,
+                               rtol=1e-9, atol=1e-9)
+    # additivity: contribs sum to the raw score
+    raw = np.asarray(bst.predict(X[:40], raw_score=True))
+    np.testing.assert_allclose(theirs.sum(axis=1), raw, atol=1e-9)
+
+
+def test_contrib_multiclass():
+    rng = np.random.RandomState(3)
+    X = rng.randn(300, 4)
+    y = np.argmax(X[:, :3], axis=1).astype(float)
+    bst = _train({"objective": "multiclass", "num_class": 3}, X, y, 8)
+    nb = NativeBooster(model_str=bst.model_to_string())
+    ours = np.asarray(bst.predict(X[:30], pred_contrib=True))
+    theirs = nb.predict(X[:30], predict_type=C_API_PREDICT_CONTRIB)
+    np.testing.assert_allclose(theirs.reshape(ours.shape), ours,
+                               rtol=1e-9, atol=1e-9)
+
+
+def test_missing_and_categorical():
+    rng = np.random.RandomState(5)
+    X = rng.randn(600, 5)
+    X[:, 2] = rng.randint(0, 8, size=600)  # categorical
+    X[rng.rand(600, 5) < 0.1] = np.nan     # missing holes
+    y = ((np.nan_to_num(X[:, 0]) > 0) ^ (X[:, 2] == 3)).astype(float)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[2])
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "min_data_in_leaf": 5}, ds, num_boost_round=12)
+    nb = NativeBooster(model_str=bst.model_to_string())
+    Xt = X[rng.permutation(600)[:100]]
+    ours = np.asarray(bst.predict(Xt))
+    theirs = nb.predict(Xt)
+    np.testing.assert_allclose(theirs.reshape(ours.shape), ours,
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_linear_trees():
+    rng = np.random.RandomState(6)
+    X = rng.randn(500, 4)
+    y = 3 * X[:, 0] + X[:, 1] + 0.05 * rng.randn(500)
+    bst = _train({"objective": "regression", "linear_tree": True}, X, y)
+    nb = NativeBooster(model_str=bst.model_to_string())
+    Xt = rng.randn(60, 4)
+    Xt[rng.rand(60, 4) < 0.1] = np.nan  # NaN rows fall back to constants
+    ours = np.asarray(bst.predict(Xt))
+    theirs = nb.predict(Xt)
+    np.testing.assert_allclose(theirs.reshape(ours.shape), ours,
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_rf_average_output():
+    rng = np.random.RandomState(8)
+    X = rng.randn(500, 5)
+    y = (X[:, 0] + X[:, 1] > 0).astype(float)
+    bst = _train({"objective": "binary", "boosting": "rf",
+                  "bagging_freq": 1, "bagging_fraction": 0.7,
+                  "feature_fraction": 0.8}, X, y, rounds=10)
+    nb = NativeBooster(model_str=bst.model_to_string())
+    ours = np.asarray(bst.predict(X[:50]))
+    theirs = nb.predict(X[:50])
+    np.testing.assert_allclose(theirs.reshape(ours.shape), ours,
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_csr_matches_dense(binary_model):
+    bst, X = binary_model
+    import scipy.sparse as sp
+    Xs = X[:50].copy()
+    Xs[np.abs(Xs) < 0.5] = 0.0
+    csr = sp.csr_matrix(Xs)
+    nb = NativeBooster(model_str=bst.model_to_string())
+    dense = nb.predict(Xs)
+    sparse = nb.predict_csr(csr.indptr, csr.indices, csr.data,
+                            num_col=Xs.shape[1])
+    np.testing.assert_allclose(sparse, dense, rtol=1e-15)
+
+
+def test_model_file_roundtrip(binary_model, tmp_path):
+    bst, X = binary_model
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    nb = NativeBooster(model_file=path)
+    ours = np.asarray(bst.predict(X[:20]))
+    np.testing.assert_allclose(nb.predict(X[:20]).reshape(ours.shape),
+                               ours, rtol=1e-12)
+    # verbatim save round-trip
+    out = str(tmp_path / "model2.txt")
+    assert nb._lib.LGBM_BoosterSaveModel(nb._handle, 0, -1, 0,
+                                         out.encode()) == 0
+    with open(path) as f1, open(out) as f2:
+        assert f1.read() == f2.read()
+    assert nb.save_model_to_string() == open(path).read()
+
+
+def test_iteration_slicing(binary_model):
+    bst, X = binary_model
+    nb = NativeBooster(model_str=bst.model_to_string())
+    ours = np.asarray(bst.predict(X[:30], raw_score=True,
+                                  start_iteration=3, num_iteration=5))
+    theirs = nb.predict(X[:30], predict_type=C_API_PREDICT_RAW_SCORE,
+                        start_iteration=3, num_iteration=5)
+    np.testing.assert_allclose(theirs.reshape(ours.shape), ours,
+                               rtol=1e-12, atol=1e-14)
+
+
+def test_reference_model_loads():
+    """A model file written by the REFERENCE binary predicts identically
+    through the C library (when the parity binary is available)."""
+    import os
+    import subprocess
+    import tempfile
+    ref = os.environ.get("LGBM_TPU_REFERENCE_BIN")
+    if not ref or not os.path.exists(ref):
+        pytest.skip("reference binary not available")
+    rng = np.random.RandomState(11)
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(float)
+    with tempfile.TemporaryDirectory() as d:
+        train = os.path.join(d, "train.csv")
+        np.savetxt(train, np.column_stack([y, X]), delimiter=",")
+        conf = os.path.join(d, "train.conf")
+        model = os.path.join(d, "model.txt")
+        with open(conf, "w") as f:
+            f.write("task=train\nobjective=binary\ndata=%s\n"
+                    "label_column=0\noutput_model=%s\nnum_trees=10\n"
+                    "verbosity=-1\nheader=false\n" % (train, model))
+        subprocess.check_call([ref, "config=%s" % conf],
+                              stdout=subprocess.DEVNULL)
+        nb = NativeBooster(model_file=model)
+        bst = lgb.Booster(model_file=model)
+        ours = np.asarray(bst.predict(X))
+        np.testing.assert_allclose(nb.predict(X).reshape(ours.shape),
+                                   ours, rtol=1e-12, atol=1e-12)
