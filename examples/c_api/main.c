@@ -1,0 +1,111 @@
+/*
+ * C host example: load a model file trained by lightgbm_tpu (or by the
+ * reference implementation — the text formats interchange) and predict
+ * without any Python runtime.
+ *
+ * Mirrors the call sequence of the reference's C API examples
+ * (reference: include/LightGBM/c_api.h usage in tests/c_api_test):
+ * create-from-modelfile -> metadata -> PredictForMat (batch) ->
+ * PredictForMatSingleRow (serving path) -> free.
+ *
+ * Build + run: see run.sh (compiles ../../lightgbm_tpu/native/capi.cpp
+ * alongside this file; no shared-library install needed).
+ *
+ * Usage: ./c_api_example <model.txt> <data.csv>
+ *   data.csv: comma-separated feature rows, no header, no label column.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "../../lightgbm_tpu/native/capi.h"
+
+#define MAX_COLS 1024
+
+static int read_csv(const char* path, double** out, int* nrow, int* ncol) {
+  FILE* f = fopen(path, "r");
+  if (!f) return 1;
+  double* data = NULL;
+  int rows = 0, cols = 0, cap = 0;
+  char line[1 << 16];
+  while (fgets(line, sizeof(line), f)) {
+    double row[MAX_COLS];
+    int c = 0;
+    for (char* tok = strtok(line, ",\n"); tok && c < MAX_COLS;
+         tok = strtok(NULL, ",\n")) {
+      row[c++] = atof(tok);
+    }
+    if (c == 0) continue;
+    if (cols == 0) cols = c;
+    if (c != cols) { fclose(f); free(data); return 2; }
+    if ((rows + 1) * cols > cap) {
+      cap = (cap ? cap * 2 : 1024 * cols);
+      data = (double*)realloc(data, cap * sizeof(double));
+    }
+    memcpy(data + (size_t)rows * cols, row, cols * sizeof(double));
+    rows++;
+  }
+  fclose(f);
+  *out = data;
+  *nrow = rows;
+  *ncol = cols;
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    fprintf(stderr, "usage: %s <model.txt> <data.csv>\n", argv[0]);
+    return 2;
+  }
+  BoosterHandle booster;
+  int num_iterations = 0;
+  if (LGBM_BoosterCreateFromModelfile(argv[1], &num_iterations,
+                                      &booster) != 0) {
+    fprintf(stderr, "load failed: %s\n", LGBM_GetLastError());
+    return 1;
+  }
+  int num_class = 0, num_feature = 0;
+  LGBM_BoosterGetNumClasses(booster, &num_class);
+  LGBM_BoosterGetNumFeature(booster, &num_feature);
+  fprintf(stderr, "model: %d iterations, %d classes, %d features\n",
+          num_iterations, num_class, num_feature);
+
+  double* data = NULL;
+  int nrow = 0, ncol = 0;
+  if (read_csv(argv[2], &data, &nrow, &ncol) != 0) {
+    fprintf(stderr, "cannot read %s\n", argv[2]);
+    return 1;
+  }
+
+  /* batch predict */
+  int64_t out_len = 0;
+  double* out = (double*)malloc((size_t)nrow * num_class * sizeof(double));
+  if (LGBM_BoosterPredictForMat(booster, data, C_API_DTYPE_FLOAT64, nrow,
+                                ncol, 1, C_API_PREDICT_NORMAL, 0, -1, "",
+                                &out_len, out) != 0) {
+    fprintf(stderr, "predict failed: %s\n", LGBM_GetLastError());
+    return 1;
+  }
+  for (int64_t i = 0; i < out_len; ++i) printf("%.17g\n", out[i]);
+
+  /* serving path: single-row call must agree with the batch call */
+  double* single = (double*)malloc((size_t)num_class * sizeof(double));
+  int64_t single_len = 0;
+  if (LGBM_BoosterPredictForMatSingleRow(booster, data,
+                                         C_API_DTYPE_FLOAT64, ncol, 1,
+                                         C_API_PREDICT_NORMAL, 0, -1, "",
+                                         &single_len, single) != 0) {
+    fprintf(stderr, "single-row predict failed: %s\n", LGBM_GetLastError());
+    return 1;
+  }
+  if (single_len != num_class || single[0] != out[0]) {
+    fprintf(stderr, "single-row mismatch\n");
+    return 1;
+  }
+  free(single);
+
+  free(out);
+  free(data);
+  LGBM_BoosterFree(booster);
+  return 0;
+}

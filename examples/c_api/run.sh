@@ -1,0 +1,45 @@
+#!/bin/bash
+# Train a small model with the Python CLI, then predict from a pure-C
+# host through the C ABI (no Python at inference time).
+set -euo pipefail
+cd "$(dirname "$0")"
+export PYTHONPATH="$(cd ../.. && pwd)${PYTHONPATH:+:$PYTHONPATH}"
+
+WORK=${1:-$(mktemp -d)}
+
+# 1. train via the conf-file CLI on the binary_classification example
+python ../generate_data.py binary "$WORK" >/dev/null 2>&1 || true
+if [ ! -f "$WORK/binary.train" ]; then
+  python - "$WORK" <<'EOF'
+import sys, numpy as np
+work = sys.argv[1]
+rng = np.random.RandomState(0)
+for name, n in (("binary.train", 1500), ("binary.test", 300)):
+    X = rng.randn(n, 8)
+    y = (X[:, 0] - X[:, 1] + 0.3 * rng.randn(n) > 0).astype(int)
+    np.savetxt("%s/%s" % (work, name),
+               np.column_stack([y, X]), delimiter="\t", fmt="%.10g")
+EOF
+fi
+python -m lightgbm_tpu.application task=train objective=binary \
+  data="$WORK/binary.train" output_model="$WORK/model.txt" \
+  num_trees=20 num_leaves=31 verbosity=-1
+
+# 2. strip the label column for the C host's feature-only CSV
+python - "$WORK" <<'EOF'
+import sys
+import numpy as np
+work = sys.argv[1]
+rows = np.loadtxt(work + "/binary.test", delimiter="\t")
+np.savetxt(work + "/features.csv", rows[:, 1:], delimiter=",", fmt="%.10g")
+EOF
+
+# 3. compile the C host (capi.cpp compiled in directly; a shared
+#    _capi.so + -l link works identically)
+g++ -O2 -std=c++17 -o "$WORK/c_api_example" main.c \
+  ../../lightgbm_tpu/native/capi.cpp -lm
+
+# 4. predict from C
+"$WORK/c_api_example" "$WORK/model.txt" "$WORK/features.csv" \
+  > "$WORK/preds_c.txt"
+echo "C predictions written: $WORK/preds_c.txt ($(wc -l < "$WORK/preds_c.txt") rows)"

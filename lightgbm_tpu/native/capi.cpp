@@ -831,6 +831,34 @@ LGBM_EXPORT int LGBM_BoosterPredictForCSR(
   return 0;
 }
 
+// single-row fast paths (reference: c_api.h PredictForMatSingleRow /
+// PredictForCSRSingleRow — the serving hot path; same semantics as the
+// batched calls with nrow == 1)
+LGBM_EXPORT int LGBM_BoosterPredictForMatSingleRow(
+    void* handle, const void* data, int data_type, int32_t ncol,
+    int is_row_major, int predict_type, int start_iteration,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result) {
+  return LGBM_BoosterPredictForMat(handle, data, data_type, 1, ncol,
+                                   is_row_major, predict_type,
+                                   start_iteration, num_iteration,
+                                   parameter, out_len, out_result);
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForCSRSingleRow(
+    void* handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int start_iteration, int num_iteration, const char* parameter,
+    int64_t* out_len, double* out_result) {
+  if (nindptr != 2) return Fail("single-row CSR requires nindptr == 2");
+  return LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices,
+                                   data, data_type, nindptr, nelem,
+                                   num_col, predict_type,
+                                   start_iteration, num_iteration,
+                                   parameter, out_len, out_result);
+}
+
 LGBM_EXPORT int LGBM_BoosterSaveModelToString(
     void* handle, int start_iteration, int num_iteration,
     int feature_importance_type, int64_t buffer_len, int64_t* out_len,

@@ -65,6 +65,27 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
                               int start_iteration, int num_iteration,
                               const char* parameter, int64_t* out_len,
                               double* out_result);
+int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle,
+                                       const void* data, int data_type,
+                                       int32_t ncol, int is_row_major,
+                                       int predict_type,
+                                       int start_iteration,
+                                       int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len,
+                                       double* out_result);
+int LGBM_BoosterPredictForCSRSingleRow(BoosterHandle handle,
+                                       const void* indptr,
+                                       int indptr_type,
+                                       const int32_t* indices,
+                                       const void* data, int data_type,
+                                       int64_t nindptr, int64_t nelem,
+                                       int64_t num_col, int predict_type,
+                                       int start_iteration,
+                                       int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len,
+                                       double* out_result);
 int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
                           int num_iteration, int feature_importance_type,
                           const char* filename);

@@ -75,6 +75,16 @@ _SIGNATURES = {
          ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
          ctypes.c_int, ctypes.c_int, ctypes.c_char_p, _p(ctypes.c_int64),
          _p(ctypes.c_double)],
+    "LGBM_BoosterPredictForMatSingleRow":
+        [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int32,
+         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+         ctypes.c_char_p, _p(ctypes.c_int64), _p(ctypes.c_double)],
+    "LGBM_BoosterPredictForCSRSingleRow":
+        [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+         _p(ctypes.c_int32), ctypes.c_void_p, ctypes.c_int,
+         ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+         ctypes.c_int, ctypes.c_int, ctypes.c_char_p, _p(ctypes.c_int64),
+         _p(ctypes.c_double)],
     "LGBM_BoosterSaveModel":
         [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
          ctypes.c_char_p],

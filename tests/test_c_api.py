@@ -8,6 +8,7 @@ trains with the Python runtime, then drives the C library through the
 same ctypes call sequence an R/Java/C host would use and requires
 agreement with the Python predictor.
 """
+import os
 import numpy as np
 import pytest
 
@@ -228,3 +229,41 @@ def test_reference_model_loads():
         ours = np.asarray(bst.predict(X))
         np.testing.assert_allclose(nb.predict(X).reshape(ours.shape),
                                    ours, rtol=1e-12, atol=1e-12)
+
+
+def test_single_row_matches_batch(binary_model):
+    bst, X = binary_model
+    lib = load_lib()
+    import ctypes
+    nb = NativeBooster(model_str=bst.model_to_string())
+    row = np.ascontiguousarray(X[7], dtype=np.float64)
+    out = np.empty(1, dtype=np.float64)
+    out_len = ctypes.c_int64()
+    rc = lib.LGBM_BoosterPredictForMatSingleRow(
+        nb._handle, row.ctypes.data_as(ctypes.c_void_p), 1,
+        row.shape[0], 1, C_API_PREDICT_NORMAL, 0, -1, b"",
+        ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0 and out_len.value == 1
+    batch = nb.predict(X[7:8])
+    assert out[0] == batch[0, 0]
+
+
+def test_c_example_end_to_end(tmp_path):
+    """The examples/c_api host compiles, loads a CLI-trained model, and
+    its predictions match the Python predictor."""
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(root, "examples", "c_api", "run.sh")
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    subprocess.check_call(["bash", script, str(tmp_path)], env=env,
+                          stdout=subprocess.DEVNULL,
+                          stderr=subprocess.DEVNULL)
+    preds_c = np.loadtxt(tmp_path / "preds_c.txt")
+    feats = np.loadtxt(tmp_path / "features.csv", delimiter=",")
+    bst = lgb.Booster(model_file=str(tmp_path / "model.txt"))
+    np.testing.assert_allclose(preds_c, np.asarray(bst.predict(feats)),
+                               rtol=1e-10)
