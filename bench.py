@@ -174,16 +174,38 @@ def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
     for _ in range(warmup):
         booster.train_one_iter()
     jax.block_until_ready(booster.train_score)
+    # batched device loop: T iterations per dispatch amortize the
+    # tunnel's per-dispatch latency (boosting/gbdt.py train_batch);
+    # warm its compile with one full batch so the measure loop sees
+    # steady state only
+    batch = int(os.environ.get("BENCH_TREE_BATCH", 20))
+    # require room for the compile-warm batch AND at least one measured
+    # batch, so tiny runs never measure zero iterations
+    use_batch = (batch > 1 and n_iters - warmup >= 2 * batch
+                 and booster.can_train_batched())
+    if use_batch:
+        booster.train_batch(batch)
+        jax.block_until_ready(booster.train_score)
+        warmup += batch  # those trees count as warmup in the report
     t_warm = time.time() - t0
-    _stage("warmed", rows=n_rows, t_warm=round(t_warm, 1))
+    _stage("warmed", rows=n_rows, t_warm=round(t_warm, 1),
+           batched=use_batch)
     budget = max(60.0, budget - t_warm)  # warmup eats into the budget
 
     t0 = time.time()
     done = 0
-    for _ in range(n_iters - warmup):
-        booster.train_one_iter()
-        done += 1
-        if done % 10 == 0:
+    # partial tail batches would recompile the scan for a new length
+    # mid-measurement; round down to full batches instead
+    target_iters = ((n_iters - warmup) // batch * batch if use_batch
+                    else n_iters - warmup)
+    while done < target_iters:
+        if use_batch:
+            booster.train_batch(batch)
+            done += batch
+        else:
+            booster.train_one_iter()
+            done += 1
+        if use_batch or done % 10 == 0:
             # sync without a device-to-host copy (a host transfer through
             # the tunnel would bias the measured rate)
             jax.block_until_ready(booster.train_score)

@@ -229,6 +229,15 @@ class Booster:
         self.best_score: Dict[str, Dict[str, float]] = {}
         self._valid_names: List[str] = []
         if train_set is not None:
+            if train_set._handle is None:
+                # dataset-level knobs (monotone_constraints, max_bin,
+                # categorical_feature, ...) passed at the Booster level
+                # must reach construction, same precedence as
+                # engine.train: the dataset's own params win (reference:
+                # Booster::Booster passes the params string into
+                # Dataset construction, c_api.cpp)
+                train_set.params = dict(self.params,
+                                        **(train_set.params or {}))
             train_set.construct()
             self.inner: GBDT = create_boosting(self.config,
                                                train_set.handle)

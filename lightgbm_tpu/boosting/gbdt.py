@@ -376,6 +376,73 @@ class GBDT:
         return False
 
     # ------------------------------------------------------------------
+    # Device-resident batched iterations (mesh learners): amortize the
+    # per-iteration dispatch/sync cost of a remote chip by running T
+    # iterations per dispatch (parallel/data_parallel.py train_many).
+    # ------------------------------------------------------------------
+    def can_train_batched(self) -> bool:
+        """True when T iterations can run without host participation:
+        single-model objective, no row sampling (bagging/GOSS draw host
+        RNG per iteration), no leaf-output renewal or linear refits
+        (host-side percentiles / least squares per tree), and a learner
+        whose scan needs no per-tree host state."""
+        from .sample_strategy import SampleStrategy
+        return (self.num_tree_per_iteration == 1
+                and self.objective is not None
+                and not self.objective.is_renew_tree_output
+                and not self.config.linear_tree
+                and type(self.sample_strategy) is SampleStrategy
+                and len(self.models) >= 1  # iter 0 seeds boost_from_avg
+                and getattr(self.learner, "supports_train_many",
+                            lambda: False)())
+
+    def train_batch(self, n_iters: int) -> bool:
+        """Run ``n_iters`` boosting iterations in one device dispatch;
+        returns True when training should stop (an iteration produced
+        no splittable leaf). Caller must have checked
+        can_train_batched()."""
+        from ..treelearner.serial import (apply_split_record,
+                                          record_is_valid)
+        learner = self.learner
+        base = learner._tree_idx
+        seeds = [(learner._extra_seed + 7919 * (base + 1 + t))
+                 & 0x7FFFFFFF for t in range(n_iters)]
+        score0 = self.train_score[:, 0]
+        score_t, recs = learner.train_many(
+            self.objective.get_gradients, score0, seeds,
+            self.shrinkage_rate)
+        recs_h = jax.device_get(recs)
+        kb = max(learner.L - 1, 1)
+        stopped = False
+        for t in range(n_iters):
+            tree = Tree(learner.L)
+            grew = False
+            for i in range(kb):
+                r = jax.tree_util.tree_map(lambda a: a[t, i], recs_h)
+                if not record_is_valid(r):
+                    break
+                apply_split_record(tree, self.train_data, r)
+                grew = True
+            if not grew:
+                # no-splittable-leaves: the device added zero output for
+                # this and every later step, so the score is consistent
+                # with stopping here (reference: gbdt.cpp:407)
+                log.warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+                stopped = True
+                break
+            tree.apply_shrinkage(self.shrinkage_rate)
+            self.models.append(tree)
+            for vd in self.valid_data:
+                vd.add_tree(tree, 0, self._bin_meta)
+            self.iter += 1
+        # score_t is correct even for a partial batch: a stump step (and
+        # every step after it, which sees the same score and grows the
+        # same stump) contributed zero output on device
+        self.train_score = self.train_score.at[:, 0].set(score_t)
+        return stopped
+
+    # ------------------------------------------------------------------
     def _update_score(self, tree: Tree, leaf_of_row: jnp.ndarray,
                       class_id: int) -> None:
         """Device gather of leaf outputs over the learner's final

@@ -18,6 +18,7 @@ def refresh_learner_params(learner, config) -> None:
         # mesh learners: the per-instance jits bake params/max_depth as
         # constants — drop them; train()/the adapters rebuild lazily
         for attr in ("_root_fn", "_tree_fn", "_step_fn", "_cegb_root_fn",
-                     "_mono_step_fn", "_mono_root_fn", "_adv_rescan_fn"):
+                     "_mono_step_fn", "_mono_root_fn", "_adv_rescan_fn",
+                     "_many_fn"):
             if hasattr(learner, attr):
                 setattr(learner, attr, None)

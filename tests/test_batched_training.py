@@ -1,0 +1,149 @@
+"""Device-resident batched boosting (train_batch / train_many).
+
+The batched path runs T iterations per dispatch to amortize remote-chip
+round-trips (gbdt.py train_batch, data_parallel.py train_many). Its
+contract: the same trees as the per-iteration loop — identical
+structure, leaf values, and counts; split_gain may differ in the last
+f32 ulp because the same subgraph compiled inside the scan module can
+tile its reductions differently (the established mesh-vs-serial
+contract, tests/test_data_parallel.py) — same stopping semantics, and
+honest eligibility gating for every feature that needs per-iteration
+host state. The reference's analogue is the CUDA whole-loop learner
+(cuda_single_gpu_tree_learner.cpp:128), which this extends across
+iterations.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _make(params_extra=None, n=3000, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 10).astype(np.float32)
+    y = (X[:, 0] + 0.7 * X[:, 1] + 0.2 * rng.randn(n) > 0).astype(float)
+    params = {"objective": "binary", "verbosity": -1, "num_leaves": 31,
+              "min_data_in_leaf": 20, "tree_learner": "data",
+              "mesh_shape": "data=1"}
+    params.update(params_extra or {})
+    bst = lgb.Booster(params=params, train_set=lgb.Dataset(X, label=y))
+    return bst, X, y
+
+
+def _tree_strings(bst):
+    return [t.to_string() for t in bst.inner.models]
+
+
+def _assert_trees_equal(t1, t2, gain_rtol=1e-6):
+    assert t1.num_leaves == t2.num_leaves
+    ni = t1.num_internal
+    np.testing.assert_array_equal(t1.split_feature[:ni],
+                                  t2.split_feature[:ni])
+    np.testing.assert_array_equal(t1.threshold_in_bin[:ni],
+                                  t2.threshold_in_bin[:ni])
+    np.testing.assert_array_equal(t1.decision_type[:ni],
+                                  t2.decision_type[:ni])
+    np.testing.assert_array_equal(t1.leaf_count[:t1.num_leaves],
+                                  t2.leaf_count[:t2.num_leaves])
+    # leaf outputs are f32 quantities; a couple of ulps of score drift
+    # (f32 lr multiply on device vs f64 shrinkage on host) is the
+    # documented batched-path tolerance
+    np.testing.assert_allclose(t1.leaf_value[:t1.num_leaves],
+                               t2.leaf_value[:t2.num_leaves],
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(t1.split_gain[:ni], t2.split_gain[:ni],
+                               rtol=gain_rtol, atol=1e-4)
+
+
+def test_batched_matches_looped():
+    a, X, y = _make()
+    b, _, _ = _make()
+    # iteration 0 (boost_from_average) runs per-iteration on both
+    a.update()
+    b.update()
+    assert a.inner.can_train_batched()
+    stopped = a.inner.train_batch(6)
+    assert not stopped
+    for _ in range(6):
+        b.update()
+    assert len(a.inner.models) == len(b.inner.models) == 7
+    for t1, t2 in zip(a.inner.models, b.inner.models):
+        _assert_trees_equal(t1, t2)
+    # the device-maintained score equals the sum of host tree outputs
+    pred_a = np.asarray(a.predict(X, raw_score=True))
+    score_a = np.asarray(a.inner.train_score[:, 0], dtype=np.float64)
+    np.testing.assert_allclose(score_a, pred_a, atol=1e-5)
+
+
+def test_batched_deterministic():
+    a, _, _ = _make(seed=3)
+    b, _, _ = _make(seed=3)
+    a.update()
+    b.update()
+    a.inner.train_batch(4)
+    b.inner.train_batch(4)
+    assert _tree_strings(a) == _tree_strings(b)
+
+
+def test_batched_quality():
+    bst, X, y = _make(n=5000, seed=5)
+    bst.update()
+    bst.inner.train_batch(30)
+    pred = np.asarray(bst.predict(X))
+    # training separates the classes decisively
+    assert pred[y == 1].mean() - pred[y == 0].mean() > 0.5
+
+
+@pytest.mark.parametrize("params", [
+    {"bagging_fraction": 0.8, "bagging_freq": 1},
+    {"data_sample_strategy": "goss"},
+    {"feature_fraction": 0.5},
+    {"feature_fraction_bynode": 0.5},
+    {"objective": "quantile"},  # leaf-output renewal
+    {"monotone_constraints": [1] + [0] * 9,
+     "monotone_constraints_method": "intermediate"},
+    {"cegb_penalty_split": 0.1},
+    {"num_class": 3, "objective": "multiclass"},
+    {"extra_trees": True},  # per-seed rand_bins vs partial-batch stop
+])
+def test_eligibility_gating(params):
+    rng = np.random.RandomState(7)
+    X = rng.randn(500, 10)
+    if params.get("objective") == "multiclass":
+        y = rng.randint(0, 3, 500).astype(float)
+    else:
+        y = (X[:, 0] > 0).astype(float)
+    p = {"objective": "binary", "verbosity": -1, "num_leaves": 15,
+         "tree_learner": "data", "mesh_shape": "data=1"}
+    p.update(params)
+    bst = lgb.Booster(params=p, train_set=lgb.Dataset(X, label=y))
+    bst.update()
+    assert not bst.inner.can_train_batched()
+
+
+def test_serial_learner_not_batched():
+    rng = np.random.RandomState(9)
+    X = rng.randn(300, 5)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.Booster(params={"objective": "binary", "verbosity": -1,
+                              "tree_learner": "serial"},
+                      train_set=lgb.Dataset(X, label=y))
+    bst.update()
+    assert not bst.inner.can_train_batched()
+
+
+def test_batched_on_8dev_mesh():
+    """Batching must not change results relative to looping ON THE SAME
+    mesh — the sharded-mesh numerics themselves (8-way psum vs single
+    device) are the looped learners' already-tested contract
+    (test_data_parallel), not this feature's."""
+    a, _, _ = _make({"mesh_shape": "data=8"}, n=2000, seed=11)
+    b, _, _ = _make({"mesh_shape": "data=8"}, n=2000, seed=11)
+    a.update()
+    b.update()
+    a.inner.train_batch(3)
+    for _ in range(3):
+        b.update()
+    assert len(a.inner.models) == len(b.inner.models) == 4
+    for t1, t2 in zip(a.inner.models, b.inner.models):
+        _assert_trees_equal(t1, t2)

@@ -1,0 +1,115 @@
+"""Per-phase wall-time breakdown of one boosting iteration.
+
+Answers "where does the tree-build time go" on real hardware: gradient
+computation, gh staging, root dispatch, whole-tree dispatch, record
+read-back, score update — each fenced with block_until_ready so the
+tunnel's async dispatch can't smear phases together. The reference's
+equivalent is its per-tree timer dump (src/treelearner/
+serial_tree_learner.cpp Global timer); here the phases map to the
+mesh learner's actual dispatch structure (parallel/data_parallel.py
+train()).
+
+Usage:  python tools/tpu_phase_timer.py [rows] [n_trees]
+Prints one JSON line per tree plus a summary.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __import__("os").path.join(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__)),
+    ".."))
+
+
+def main() -> None:
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    n_trees = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import make_higgs_like, _enable_compile_cache
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.boosting import create_boosting
+
+    _enable_compile_cache()
+    print(json.dumps({"phase": "devices",
+                      "platform": jax.devices()[0].platform}), flush=True)
+
+    X, y = make_higgs_like(rows)
+    cfg = Config.from_params({
+        "objective": "binary", "num_leaves": 255, "max_bin": 255,
+        "learning_rate": 0.1, "verbosity": -1, "min_data_in_leaf": 100,
+        "tree_learner": "data", "mesh_shape": "data=1",
+    })
+    t0 = time.time()
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    print(json.dumps({"phase": "binned", "s": round(time.time() - t0, 2)}),
+          flush=True)
+    del X
+
+    booster = create_boosting(cfg, ds)
+    learner = booster.learner
+    objective = booster.objective
+
+    # one full warmup iteration compiles everything
+    t0 = time.time()
+    booster.train_one_iter()
+    jax.block_until_ready(booster.train_score)
+    print(json.dumps({"phase": "warmup_iter",
+                      "s": round(time.time() - t0, 2)}), flush=True)
+
+    def fence(x):
+        jax.block_until_ready(x)
+        return time.time()
+
+    totals: dict = {}
+    for k in range(n_trees):
+        rec = {}
+        t = time.time()
+        # same call shape as GBDT.train_one_iter (boosting/gbdt.py:293)
+        grad, hess = objective.get_gradients(booster.train_score[:, 0])
+        t2 = fence((grad, hess))
+        rec["grad"] = t2 - t
+
+        t = t2
+        gh = learner._make_gh(grad, hess, None)
+        t2 = fence(gh)
+        rec["stage_gh"] = t2 - t
+
+        t = t2
+        feature_mask = learner._sample_features()
+        state, root_rec = learner._root_fn(learner.bins, gh, feature_mask,
+                                           jnp.int32(k + 1))
+        t2 = fence(root_rec)
+        rec["root_fn"] = t2 - t
+
+        t = t2
+        state, recs = learner._tree_fn(learner.bins, state, feature_mask,
+                                       jnp.int32(k + 1))
+        t2 = fence(recs)
+        rec["tree_fn"] = t2 - t
+
+        t = t2
+        jax.device_get(recs)
+        t2 = time.time()
+        rec["readback"] = t2 - t
+
+        rec = {k2: round(v, 4) for k2, v in rec.items()}
+        rec["tree"] = k
+        print(json.dumps(rec), flush=True)
+        for k2, v in rec.items():
+            if isinstance(v, float):
+                totals[k2] = totals.get(k2, 0.0) + v
+
+    summary = {k2: round(v / n_trees, 4) for k2, v in totals.items()}
+    summary["phase"] = "mean_per_tree"
+    summary["rows"] = rows
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()
