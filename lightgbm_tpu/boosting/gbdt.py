@@ -390,6 +390,8 @@ class GBDT:
         return (self.num_tree_per_iteration == 1
                 and self.objective is not None
                 and not self.objective.is_renew_tree_output
+                and not getattr(self.objective,
+                                "has_stochastic_gradients", False)
                 and not self.config.linear_tree
                 and type(self.sample_strategy) is SampleStrategy
                 and len(self.models) >= 1  # iter 0 seeds boost_from_avg

@@ -335,6 +335,10 @@ class Config:
     num_gpu: int = 1
     # TPU additions:
     tpu_use_f64_hist: bool = False   # analogue of gpu_use_dp (f64 hist accum)
+    # run N boosting iterations per device dispatch when nothing needs
+    # per-iteration host work (boosting/gbdt.py train_batch); amortizes
+    # remote-chip dispatch latency. 0/1 = per-iteration training.
+    tpu_batch_iterations: int = 0
     hist_backend: str = "auto"       # auto | scatter | onehot | pallas
     mesh_shape: str = ""             # e.g. "data=8" or "data=4,feature=2"
 

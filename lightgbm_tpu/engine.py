@@ -107,6 +107,44 @@ def train(params: Dict[str, Any], train_set: Dataset,
     callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
+    # tpu_batch_iterations: run N iterations per device dispatch
+    # (gbdt.py train_batch). Callbacks, eval sets, and custom objectives
+    # observe every iteration, so batching only engages without them.
+    batch_n = int(cfg.tpu_batch_iterations)
+    if batch_n > 1 and not (callbacks or valid_sets
+                            or eval_train_requested or fobj):
+        i = 0
+        while i < num_boost_round:
+            if (booster.inner.can_train_batched()
+                    and num_boost_round - i >= batch_n):
+                # full batches only: a shorter tail scan would recompile
+                # the whole T-iteration program for a one-off length
+                finished = booster.inner.train_batch(batch_n)
+                i += batch_n
+            else:
+                finished = booster.update(fobj=fobj)
+                i += 1
+                if i >= 1 and not booster.inner.can_train_batched():
+                    # permanently ineligible config: fall through to the
+                    # plain loop without re-checking every iteration
+                    log.warning(
+                        "tpu_batch_iterations=%d ignored: the "
+                        "configuration needs per-iteration host work "
+                        "(sampling/monotone/CEGB/linear/renewal/"
+                        "multiclass)" % batch_n)
+                    for _ in range(i, num_boost_round):
+                        if booster.update(fobj=fobj):
+                            break
+                    break
+            if finished:
+                break
+        booster.best_iteration = booster.current_iteration
+        return booster
+    elif batch_n > 1:
+        log.warning("tpu_batch_iterations=%d ignored: callbacks, valid "
+                    "sets, or a custom objective need per-iteration "
+                    "evaluation" % batch_n)
+
     for i in range(num_boost_round):
         for cb in callbacks_before:
             cb(callback_mod.CallbackEnv(

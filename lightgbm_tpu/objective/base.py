@@ -81,6 +81,14 @@ class ObjectiveFunction:
         ObjectiveFunction::ConvertOutput; identity except sigmoid/exp/etc.)."""
         return score
 
+    @property
+    def has_stochastic_gradients(self) -> bool:
+        """True when get_gradients draws fresh randomness per call
+        (rank_xendcg's per-query uniforms): such objectives cannot run
+        inside a traced multi-iteration scan, which would bake one draw
+        in at trace time."""
+        return False
+
     # ------------------------------------------------------------------
     def renew_tree_output(self, tree, score: np.ndarray,
                           leaf_of_row: np.ndarray,

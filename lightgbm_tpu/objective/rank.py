@@ -213,6 +213,10 @@ class RankXENDCG(ObjectiveFunction):
     name = "rank_xendcg"
     need_group = True
 
+    # fresh U[0,1) per call - incompatible with traced multi-iteration
+    # scans (see ObjectiveFunction.has_stochastic_gradients)
+    has_stochastic_gradients = True
+
     def __init__(self, config):
         super().__init__(config)
         self.seed = int(config.objective_seed)

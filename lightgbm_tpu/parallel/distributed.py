@@ -111,6 +111,13 @@ class DistributedDataParallelLearner(DataParallelTreeLearner):
     processes and XLA's collectives ride ICI/DCN (reference analogue:
     DataParallelTreeLearner over MPI ranks)."""
 
+    def supports_train_many(self) -> bool:
+        """The batched scan hardcodes the single-process tail-pad gh
+        layout (_make_gh_traced) and the [:N] partition slice; this
+        learner's per-process interleaved pad blocks need their own
+        staging, so the batched path stays off multi-process meshes."""
+        return False
+
     def __init__(self, config, local_dataset: BinnedDataset, mesh: Mesh,
                  axis: str = "data"):
         from jax.experimental import multihost_utils

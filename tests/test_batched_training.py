@@ -147,3 +147,69 @@ def test_batched_on_8dev_mesh():
     assert len(a.inner.models) == len(b.inner.models) == 4
     for t1, t2 in zip(a.inner.models, b.inner.models):
         _assert_trees_equal(t1, t2)
+
+
+def test_engine_tpu_batch_iterations():
+    """engine.train honors tpu_batch_iterations and produces the same
+    model as the per-iteration loop."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(3000, 10).astype(np.float32)
+    y = (X[:, 0] + 0.7 * X[:, 1] + 0.2 * rng.randn(3000) > 0).astype(float)
+    base = {"objective": "binary", "verbosity": -1, "num_leaves": 31,
+            "min_data_in_leaf": 20, "tree_learner": "data",
+            "mesh_shape": "data=1"}
+    a = lgb.train(dict(base, tpu_batch_iterations=3),
+                  lgb.Dataset(X, label=y), num_boost_round=7)
+    b = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=7)
+    assert len(a.inner.models) == len(b.inner.models) == 7
+    for t1, t2 in zip(a.inner.models, b.inner.models):
+        _assert_trees_equal(t1, t2)
+    assert a.current_iteration == 7
+
+
+def test_engine_batch_knob_falls_back_with_callbacks():
+    rng = np.random.RandomState(22)
+    X = rng.randn(600, 6)
+    y = (X[:, 0] > 0).astype(float)
+    seen = []
+
+    def cb(env):
+        seen.append(env.iteration)
+
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "tpu_batch_iterations": 4, "num_leaves": 15,
+                     "tree_learner": "data", "mesh_shape": "data=1"},
+                    lgb.Dataset(X, label=y), num_boost_round=6,
+                    callbacks=[cb])
+    # callbacks force the per-iteration loop: one env per iteration
+    assert seen == list(range(6))
+    assert len(bst.inner.models) == 6
+
+
+def test_engine_batch_knob_falls_back_when_ineligible():
+    rng = np.random.RandomState(23)
+    X = rng.randn(600, 6)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "tpu_batch_iterations": 4, "num_leaves": 15,
+                     "bagging_fraction": 0.8, "bagging_freq": 1,
+                     "tree_learner": "data", "mesh_shape": "data=1"},
+                    lgb.Dataset(X, label=y), num_boost_round=6)
+    assert len(bst.inner.models) == 6
+
+
+def test_rank_xendcg_not_batched():
+    """rank_xendcg resamples per-query uniforms every gradient call; a
+    traced scan would bake one draw in at trace time, so it must be
+    gated out of the batched path."""
+    rng = np.random.RandomState(31)
+    n_q, per_q = 40, 10
+    X = rng.randn(n_q * per_q, 6)
+    y = rng.randint(0, 4, n_q * per_q).astype(float)
+    ds = lgb.Dataset(X, label=y, group=[per_q] * n_q)
+    bst = lgb.Booster(params={"objective": "rank_xendcg",
+                              "verbosity": -1, "num_leaves": 15,
+                              "tree_learner": "data",
+                              "mesh_shape": "data=1"}, train_set=ds)
+    bst.update()
+    assert not bst.inner.can_train_batched()
