@@ -387,29 +387,36 @@ class GBDT:
         (host-side percentiles / least squares per tree), and a learner
         whose scan needs no per-tree host state."""
         from .sample_strategy import SampleStrategy
-        return (self.num_tree_per_iteration == 1
-                and self.objective is not None
+        return (self.objective is not None
                 and not self.objective.is_renew_tree_output
                 and not getattr(self.objective,
                                 "has_stochastic_gradients", False)
                 and not self.config.linear_tree
                 and type(self.sample_strategy) is SampleStrategy
                 and len(self.models) >= 1  # iter 0 seeds boost_from_avg
+                and all(self.class_need_train)
                 and getattr(self.learner, "supports_train_many",
                             lambda: False)())
 
     def train_batch(self, n_iters: int) -> bool:
         """Run ``n_iters`` boosting iterations in one device dispatch;
-        returns True when training should stop (an iteration produced
-        no splittable leaf). Caller must have checked
+        returns True when training should stop (an iteration grew no
+        tree in any class). Caller must have checked
         can_train_batched()."""
         from ..treelearner.serial import (apply_split_record,
                                           record_is_valid)
         learner = self.learner
+        K = self.num_tree_per_iteration
         base = learner._tree_idx
-        seeds = [(learner._extra_seed + 7919 * (base + 1 + t))
-                 & 0x7FFFFFFF for t in range(n_iters)]
-        score0 = self.train_score[:, 0]
+        if K == 1:
+            seeds = [(learner._extra_seed + 7919 * (base + 1 + t))
+                     & 0x7FFFFFFF for t in range(n_iters)]
+            score0 = self.train_score[:, 0]
+        else:
+            seeds = [[(learner._extra_seed
+                       + 7919 * (base + 1 + t * K + k)) & 0x7FFFFFFF
+                      for k in range(K)] for t in range(n_iters)]
+            score0 = self.train_score
         score_t, recs = learner.train_many(
             self.objective.get_gradients, score0, seeds,
             self.shrinkage_rate)
@@ -417,31 +424,49 @@ class GBDT:
         kb = max(learner.L - 1, 1)
         stopped = False
         for t in range(n_iters):
-            tree = Tree(learner.L)
-            grew = False
-            for i in range(kb):
-                r = jax.tree_util.tree_map(lambda a: a[t, i], recs_h)
-                if not record_is_valid(r):
-                    break
-                apply_split_record(tree, self.train_data, r)
-                grew = True
-            if not grew:
-                # no-splittable-leaves: the device added zero output for
-                # this and every later step, so the score is consistent
-                # with stopping here (reference: gbdt.cpp:407)
+            iter_trees = []
+            grew_any = False
+            for k in range(K):
+                tree = Tree(learner.L)
+                grew = False
+                for i in range(kb):
+                    r = jax.tree_util.tree_map(
+                        lambda a: a[t, k, i] if K > 1 else a[t, i],
+                        recs_h)
+                    if not record_is_valid(r):
+                        break
+                    apply_split_record(tree, self.train_data, r)
+                    grew = True
+                if grew:
+                    tree.apply_shrinkage(self.shrinkage_rate)
+                    grew_any = True
+                else:
+                    # class grew nothing: zero-valued stump, exactly the
+                    # looped path's constant tree (device added zero)
+                    tree = Tree(1)
+                iter_trees.append(tree)
+            if not grew_any:
+                # no-splittable-leaves in ANY class: the device added
+                # zero output for this and every later step, so the
+                # score is consistent with stopping here
+                # (reference: gbdt.cpp:407)
                 log.warning("Stopped training because there are no more "
                             "leaves that meet the split requirements")
                 stopped = True
                 break
-            tree.apply_shrinkage(self.shrinkage_rate)
-            self.models.append(tree)
-            for vd in self.valid_data:
-                vd.add_tree(tree, 0, self._bin_meta)
+            for k, tree in enumerate(iter_trees):
+                self.models.append(tree)
+                if tree.num_leaves > 1:
+                    for vd in self.valid_data:
+                        vd.add_tree(tree, k, self._bin_meta)
             self.iter += 1
         # score_t is correct even for a partial batch: a stump step (and
         # every step after it, which sees the same score and grows the
         # same stump) contributed zero output on device
-        self.train_score = self.train_score.at[:, 0].set(score_t)
+        if K == 1:
+            self.train_score = self.train_score.at[:, 0].set(score_t)
+        else:
+            self.train_score = score_t
         return stopped
 
     # ------------------------------------------------------------------

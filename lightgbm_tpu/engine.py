@@ -124,14 +124,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
             else:
                 finished = booster.update(fobj=fobj)
                 i += 1
-                if i >= 1 and not booster.inner.can_train_batched():
+                if finished:
+                    break
+                if not booster.inner.can_train_batched():
                     # permanently ineligible config: fall through to the
                     # plain loop without re-checking every iteration
                     log.warning(
                         "tpu_batch_iterations=%d ignored: the "
                         "configuration needs per-iteration host work "
-                        "(sampling/monotone/CEGB/linear/renewal/"
-                        "multiclass)" % batch_n)
+                        "(sampling/monotone/CEGB/linear/renewal, a "
+                        "stochastic-gradient objective, or a "
+                        "multi-process learner)" % batch_n)
                     for _ in range(i, num_boost_round):
                         if booster.update(fobj=fobj):
                             break

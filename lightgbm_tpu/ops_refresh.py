@@ -19,6 +19,6 @@ def refresh_learner_params(learner, config) -> None:
         # constants — drop them; train()/the adapters rebuild lazily
         for attr in ("_root_fn", "_tree_fn", "_step_fn", "_cegb_root_fn",
                      "_mono_step_fn", "_mono_root_fn", "_adv_rescan_fn",
-                     "_many_fn"):
+                     "_many_fn", "_many_multi_fn"):
             if hasattr(learner, attr):
                 setattr(learner, attr, None)

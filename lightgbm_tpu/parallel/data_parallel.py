@@ -192,6 +192,7 @@ class DataParallelTreeLearner(CapabilityMixin):
         self._mono_root_fn = None
         self._adv_rescan_fn = None
         self._many_fn = None
+        self._many_multi_fn = None
         self._many_grad_fn = None
         return cols_host
 
@@ -747,6 +748,17 @@ class DataParallelTreeLearner(CapabilityMixin):
         out = jnp.zeros(L + 1, dtype=jnp.float32)
         return jax.lax.fori_loop(0, L - 1, body, out)[:L]
 
+    def _grow_one(self, bins, gh, feature_mask, seed, lr):
+        """One tree inside the scan: root + whole-tree loop + leaf-output
+        replay. Returns (records, per-row output deltas [N])."""
+        barrier = jax.lax.optimization_barrier
+        state, _ = self._root_impl(bins, gh, feature_mask, seed)
+        state = barrier(state)
+        state, recs = self._tree_impl(bins, state, feature_mask, seed)
+        state, recs = barrier((state, recs))
+        outs = self._leaf_outputs_from_records(recs) * lr
+        return recs, outs[state.leaf_of_row[:self.N]]
+
     def _many_impl(self, bins, score0, seeds, feature_mask, lr):
         # optimization_barrier at every boundary that is a separate
         # dispatch in the per-iteration path: without them XLA fuses the
@@ -755,33 +767,56 @@ class DataParallelTreeLearner(CapabilityMixin):
         barrier = jax.lax.optimization_barrier
 
         def step(score, seed):
+            # score [N] (single-model objectives)
             grad, hess = barrier(self._many_grad_fn(score))
             gh = barrier(self._make_gh_traced(grad, hess))
-            state, _ = self._root_impl(bins, gh, feature_mask, seed)
-            state = barrier(state)
-            state, recs = self._tree_impl(bins, state, feature_mask, seed)
-            state, recs = barrier((state, recs))
-            outs = self._leaf_outputs_from_records(recs) * lr
-            score = score + outs[state.leaf_of_row[:self.N]]
+            recs, delta = self._grow_one(bins, gh, feature_mask, seed, lr)
+            return barrier(score + delta), recs
+
+        return jax.lax.scan(step, score0, seeds)
+
+    def _many_impl_multi(self, bins, score0, seeds, feature_mask, lr):
+        # K trees per iteration (multiclass): one gradient pass per step
+        # over the [N, K] scores, then a statically unrolled per-class
+        # tree (reference: the k-loop of GBDT::TrainOneIter)
+        barrier = jax.lax.optimization_barrier
+        K = int(seeds.shape[1])
+
+        def step(score, seeds_k):
+            grad, hess = barrier(self._many_grad_fn(score))
+            all_recs = []
+            for k in range(K):
+                gh = barrier(self._make_gh_traced(grad[:, k], hess[:, k]))
+                recs, delta = self._grow_one(bins, gh, feature_mask,
+                                             seeds_k[k], lr)
+                score = score.at[:, k].add(delta)
+                all_recs.append(recs)
+            recs = jax.tree_util.tree_map(
+                lambda *a: jnp.stack(a), *all_recs)
             return barrier(score), recs
 
         return jax.lax.scan(step, score0, seeds)
 
     def train_many(self, grad_fn, score0: jnp.ndarray, seeds,
                    shrinkage: float):
-        """Run len(seeds) boosting iterations in one dispatch. Returns
-        (final score column [N], stacked SplitRecords [T, L-1]) — the
-        record read-back is the batch's single host sync. ``grad_fn``
-        must be traceable (the objective's jitted gradient fn)."""
+        """Run T boosting iterations in one dispatch. ``seeds`` is [T]
+        (single-model objectives; ``score0`` is the [N] score column)
+        or [T, K] (K trees per iteration; ``score0`` is [N, K]).
+        Returns (final scores, stacked SplitRecords [T, (K,) L-1]) —
+        the record read-back is the batch's single host sync.
+        ``grad_fn`` must be traceable (the objective's jitted gradient
+        fn)."""
         self._ensure_compiled()
+        seeds = jnp.asarray(np.asarray(seeds, dtype=np.int32))
         # bound methods are rebuilt per attribute access: compare by
         # equality (__self__/__func__), not identity, or every batch
         # would re-jit the scan
         if self._many_fn is None or self._many_grad_fn != grad_fn:
             self._many_grad_fn = grad_fn
             self._many_fn = jax.jit(self._many_impl)
+            self._many_multi_fn = jax.jit(self._many_impl_multi)
         feature_mask = self._sample_features()
-        seeds = jnp.asarray(np.asarray(seeds, dtype=np.int32))
-        self._tree_idx += len(seeds)
-        return self._many_fn(self.bins, score0, seeds, feature_mask,
-                             jnp.float32(shrinkage))
+        self._tree_idx += int(seeds.size)
+        fn = self._many_multi_fn if seeds.ndim == 2 else self._many_fn
+        return fn(self.bins, score0, seeds, feature_mask,
+                  jnp.float32(shrinkage))

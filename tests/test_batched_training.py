@@ -103,7 +103,6 @@ def test_batched_quality():
     {"monotone_constraints": [1] + [0] * 9,
      "monotone_constraints_method": "intermediate"},
     {"cegb_penalty_split": 0.1},
-    {"num_class": 3, "objective": "multiclass"},
     {"extra_trees": True},  # per-seed rand_bins vs partial-batch stop
 ])
 def test_eligibility_gating(params):
@@ -213,3 +212,37 @@ def test_rank_xendcg_not_batched():
                               "mesh_shape": "data=1"}, train_set=ds)
     bst.update()
     assert not bst.inner.can_train_batched()
+
+
+def _make_multiclass(seed=41, objective="multiclass"):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(2500, 8).astype(np.float32)
+    y = np.argmax(X[:, :3] + 0.25 * rng.randn(2500, 3), axis=1).astype(
+        float)
+    params = {"objective": objective, "num_class": 3, "verbosity": -1,
+              "num_leaves": 15, "min_data_in_leaf": 30,
+              "tree_learner": "data", "mesh_shape": "data=1"}
+    bst = lgb.Booster(params=params, train_set=lgb.Dataset(X, label=y))
+    return bst, X, y
+
+
+@pytest.mark.parametrize("objective", ["multiclass", "multiclassova"])
+def test_multiclass_batched_matches_looped(objective):
+    """K trees per iteration inside the scan: same trees per class as
+    the looped path."""
+    a, X, y = _make_multiclass(objective=objective)
+    b, _, _ = _make_multiclass(objective=objective)
+    a.update()
+    b.update()
+    assert a.inner.can_train_batched()
+    stopped = a.inner.train_batch(4)
+    assert not stopped
+    for _ in range(4):
+        b.update()
+    assert len(a.inner.models) == len(b.inner.models) == 15  # 5 iters x 3
+    for t1, t2 in zip(a.inner.models, b.inner.models):
+        _assert_trees_equal(t1, t2)
+    # per-class scores stay aligned with the host trees
+    pred_a = np.asarray(a.predict(X, raw_score=True))
+    score_a = np.asarray(a.inner.train_score, dtype=np.float64)
+    np.testing.assert_allclose(score_a, pred_a, atol=1e-5)
