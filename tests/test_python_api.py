@@ -217,3 +217,36 @@ class TestSklearn:
         m2 = pickle.loads(pickle.dumps(model))
         np.testing.assert_allclose(model.predict(X), m2.predict(X),
                                    rtol=1e-12)
+
+
+@pytest.mark.parametrize("serializer", ["pickle", "joblib", "cloudpickle"])
+def test_serializer_matrix(serializer, tmp_path):
+    """Booster and sklearn estimator survive every serializer the
+    reference's test matrix covers (reference:
+    tests/python_package_test/utils.py:13 pickle/joblib/cloudpickle)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 5)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    est = lgb.LGBMClassifier(n_estimators=8, verbosity=-1).fit(X, y)
+    path = tmp_path / ("m.%s" % serializer)
+    for obj, predict in ((bst, lambda m: m.predict(X)),
+                         (est, lambda m: m.predict_proba(X))):
+        if serializer == "pickle":
+            with open(path, "wb") as f:
+                pickle.dump(obj, f)
+            with open(path, "rb") as f:
+                back = pickle.load(f)
+        elif serializer == "joblib":
+            import joblib
+            joblib.dump(obj, path)
+            back = joblib.load(path)
+        else:
+            import cloudpickle
+            with open(path, "wb") as f:
+                cloudpickle.dump(obj, f)
+            with open(path, "rb") as f:
+                back = pickle.load(f)
+        np.testing.assert_allclose(predict(back), predict(obj),
+                                   rtol=1e-12)
