@@ -171,22 +171,28 @@ def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
 
     booster = create_boosting(cfg, ds)
     t0 = time.time()
-    for _ in range(warmup):
-        booster.train_one_iter()
+    # iteration 0 runs per-iteration regardless (boost_from_average)
+    booster.train_one_iter()
     jax.block_until_ready(booster.train_score)
     # batched device loop: T iterations per dispatch amortize the
     # tunnel's per-dispatch latency (boosting/gbdt.py train_batch);
     # warm its compile with one full batch so the measure loop sees
-    # steady state only
+    # steady state only. The scan traces its own copy of the tree
+    # program, so extra looped warmup iterations buy nothing — batched
+    # mode warms with 1 looped iteration + 1 full batch.
     batch = int(os.environ.get("BENCH_TREE_BATCH", 20))
-    # require room for the compile-warm batch AND at least one measured
-    # batch, so tiny runs never measure zero iterations
-    use_batch = (batch > 1 and n_iters - warmup >= 2 * batch
+    use_batch = (batch > 1 and n_iters - 1 >= 2 * batch
                  and booster.can_train_batched())
     if use_batch:
+        warmup = 1
         booster.train_batch(batch)
         jax.block_until_ready(booster.train_score)
         warmup += batch  # those trees count as warmup in the report
+    else:
+        for _ in range(max(warmup - 1, 0)):
+            booster.train_one_iter()
+        jax.block_until_ready(booster.train_score)
+        warmup = max(warmup, 1)  # iteration 0 above always runs
     t_warm = time.time() - t0
     _stage("warmed", rows=n_rows, t_warm=round(t_warm, 1),
            batched=use_batch)

@@ -239,11 +239,11 @@ def test_serializer_matrix(serializer, tmp_path):
             with open(path, "rb") as f:
                 back = pickle.load(f)
         elif serializer == "joblib":
-            import joblib
+            joblib = pytest.importorskip("joblib")
             joblib.dump(obj, path)
             back = joblib.load(path)
         else:
-            import cloudpickle
+            cloudpickle = pytest.importorskip("cloudpickle")
             with open(path, "wb") as f:
                 cloudpickle.dump(obj, f)
             with open(path, "rb") as f:
