@@ -15,6 +15,25 @@ from .serial import SerialTreeLearner
 def create_tree_learner(config, dataset, mesh=None):
     name = getattr(config, "tree_learner", "serial")
     if name in ("serial",):
+        # On an accelerator the serial learner's per-split host
+        # round-trips dominate (a remote chip charges ~27 ms each; 254
+        # splits/tree — measured round 3). The 1-device-mesh data
+        # learner grows the whole tree in ONE dispatch and is pinned
+        # bit-exact to serial (tests/test_parallel_learners.py), so the
+        # DEFAULT promotes — an explicitly requested serial learner is
+        # honored, as are forced splits (serial-scan only).
+        explicit = any(k in getattr(config, "raw_params", {})
+                       for k in ("tree_learner", "tree", "tree_type",
+                                 "tree_learner_type"))
+        import jax
+        if (not explicit and jax.default_backend() != "cpu"
+                and not config.forcedsplits_filename):
+            from ..parallel import DataParallelTreeLearner, make_mesh
+            log.info("tree_learner=serial on an accelerator: using the "
+                     "1-device-mesh whole-tree learner (identical "
+                     "trees, one host sync per tree instead of one "
+                     "per split)")
+            return DataParallelTreeLearner(config, dataset, make_mesh(1))
         return SerialTreeLearner(config, dataset)
     import jax
     from ..parallel import (DataParallelTreeLearner,

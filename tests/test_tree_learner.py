@@ -194,3 +194,42 @@ def test_feature_fraction():
     tree, _ = learner.train(jnp.asarray(grad), jnp.asarray(hess))
     used = set(tree.split_feature[:tree.num_internal].tolist())
     assert len(used) <= 2  # 5 features * 0.4 = 2 allowed per tree
+
+
+def test_serial_promotes_to_mesh_on_accelerator(monkeypatch, tmp_path):
+    """The DEFAULT learner on a non-CPU backend is the 1-device-mesh
+    whole-tree learner (bit-exact to serial, one sync per tree); an
+    explicit tree_learner=serial and forced splits keep the true serial
+    scan."""
+    import json as _json
+
+    import jax
+
+    from lightgbm_tpu.parallel import DataParallelTreeLearner
+    from lightgbm_tpu.treelearner import (SerialTreeLearner,
+                                          create_tree_learner)
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 5)
+    y = (X[:, 0] > 0).astype(float)
+    cfg = Config.from_params({"objective": "binary", "verbosity": -1})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+
+    assert isinstance(create_tree_learner(cfg, ds), SerialTreeLearner)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert isinstance(create_tree_learner(cfg, ds),
+                      DataParallelTreeLearner)
+    # explicitly requested serial is honored
+    cfg_explicit = Config.from_params({"objective": "binary",
+                                       "verbosity": -1,
+                                       "tree_learner": "serial"})
+    assert isinstance(create_tree_learner(cfg_explicit, ds),
+                      SerialTreeLearner)
+    # forced splits only exist in the serial scan: no promotion
+    path = tmp_path / "forced.json"
+    path.write_text(_json.dumps({"feature": 0, "threshold": 0.0}))
+    cfg2 = Config.from_params({"objective": "binary", "verbosity": -1,
+                               "forcedsplits_filename": str(path)})
+    assert isinstance(create_tree_learner(cfg2, ds), SerialTreeLearner)
