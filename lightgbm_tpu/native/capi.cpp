@@ -866,6 +866,10 @@ LGBM_EXPORT int LGBM_BoosterSaveModelToString(
   (void)feature_importance_type;
   if (!handle || !out_len) return Fail("null argument");
   auto* b = static_cast<CBooster*>(handle);
+  if (b->raw_model.empty())
+    return Fail("model was modified in memory (SetLeafValue); the "
+                "verbatim text is gone — re-save from the training "
+                "runtime instead");
   if (start_iteration != 0 ||
       (num_iteration > 0 && num_iteration < b->NumIterations()))
     return Fail("predict-side C API keeps the loaded model verbatim; "
@@ -883,12 +887,123 @@ LGBM_EXPORT int LGBM_BoosterSaveModel(void* handle, int start_iteration,
   (void)feature_importance_type;
   if (!handle || !filename) return Fail("null argument");
   auto* b = static_cast<CBooster*>(handle);
+  if (b->raw_model.empty())
+    return Fail("model was modified in memory (SetLeafValue); the "
+                "verbatim text is gone — re-save from the training "
+                "runtime instead");
   if (start_iteration != 0 ||
       (num_iteration > 0 && num_iteration < b->NumIterations()))
     return Fail("predict-side C API keeps the loaded model verbatim");
   std::ofstream f(filename, std::ios::binary);
   if (!f) return Fail(std::string("cannot write ") + filename);
   f << b->raw_model;
+  return 0;
+}
+
+// CSC prediction: counting-sort the nonzeros into per-row (col, val)
+// buckets in O(nnz), then run the same per-row walk as CSR — no dense
+// materialization (reference: c_api.cpp PredictForCSC iterates columns
+// through an adapter for the same reason)
+LGBM_EXPORT int LGBM_BoosterPredictForCSC(
+    void* handle, const void* col_ptr, int col_ptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t ncol_ptr, int64_t nelem, int64_t num_row, int predict_type,
+    int start_iteration, int num_iteration, const char* parameter,
+    int64_t* out_len, double* out_result) {
+  (void)parameter;
+  if (!handle || !col_ptr || !indices || !data || !out_result)
+    return Fail("null argument");
+  if (data_type != kDtypeF32 && data_type != kDtypeF64)
+    return Fail("data_type must be C_API_DTYPE_FLOAT32/64");
+  int64_t ncol = ncol_ptr - 1;
+  if (ncol < 0 || num_row < 0 || ncol > INT32_MAX || num_row > INT32_MAX)
+    return Fail("bad CSC dimensions");
+  auto colptr_at = [&](int64_t c) -> int64_t {
+    return col_ptr_type == kDtypeI64
+               ? static_cast<const int64_t*>(col_ptr)[c]
+               : static_cast<const int64_t>(
+                     static_cast<const int32_t*>(col_ptr)[c]);
+  };
+  int64_t nnz = colptr_at(ncol);
+  if (nnz < 0 || nnz > nelem) return Fail("bad CSC col_ptr");
+  // counting sort by row
+  std::vector<int64_t> row_start(num_row + 1, 0);
+  for (int64_t j = 0; j < nnz; ++j) {
+    int32_t r = indices[j];
+    if (r < 0 || r >= num_row) return Fail("CSC row index out of range");
+    row_start[r + 1] += 1;
+  }
+  for (int64_t r = 0; r < num_row; ++r) row_start[r + 1] += row_start[r];
+  std::vector<int32_t> row_col(nnz);
+  std::vector<double> row_val(nnz);
+  {
+    std::vector<int64_t> cursor(row_start.begin(), row_start.end() - 1);
+    for (int64_t c = 0; c < ncol; ++c) {
+      for (int64_t j = colptr_at(c); j < colptr_at(c + 1); ++j) {
+        int64_t pos = cursor[indices[j]]++;
+        row_col[pos] = static_cast<int32_t>(c);
+        row_val[pos] =
+            (data_type == kDtypeF64)
+                ? static_cast<const double*>(data)[j]
+                : static_cast<double>(
+                      static_cast<const float*>(data)[j]);
+      }
+    }
+  }
+  auto* b = static_cast<CBooster*>(handle);
+  int t0, t1;
+  b->UsedRange(start_iteration, num_iteration, &t0, &t1);
+  int64_t stride = PredictOutputLen(b, 1, predict_type, t0, t1);
+  std::vector<double> row(ncol, 0.0);
+  ShapContext scratch;
+  for (int64_t r = 0; r < num_row; ++r) {
+    for (int64_t j = row_start[r]; j < row_start[r + 1]; ++j)
+      row[row_col[j]] = row_val[j];
+    PredictRowInto(b, row.data(), static_cast<int>(ncol), predict_type,
+                   t0, t1, out_result + r * stride, &scratch);
+    for (int64_t j = row_start[r]; j < row_start[r + 1]; ++j)
+      row[row_col[j]] = 0.0;
+  }
+  if (out_len) *out_len = num_row * stride;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetNumPredict(void* handle, int data_idx,
+                                          int64_t* out_len) {
+  (void)data_idx;
+  if (!handle || !out_len) return Fail("null argument");
+  // prediction-only runtime: no attached datasets
+  *out_len = 0;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetLeafValue(void* handle, int tree_idx,
+                                         int leaf_idx, double* out_val) {
+  if (!handle || !out_val) return Fail("null argument");
+  auto* b = static_cast<CBooster*>(handle);
+  if (tree_idx < 0 || tree_idx >= (int)b->trees.size())
+    return Fail("tree_idx out of range");
+  const CTree& t = b->trees[tree_idx];
+  if (leaf_idx < 0 || leaf_idx >= t.num_leaves)
+    return Fail("leaf_idx out of range");
+  *out_val = t.leaf_value[leaf_idx];
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterSetLeafValue(void* handle, int tree_idx,
+                                         int leaf_idx, double val) {
+  if (!handle) return Fail("null argument");
+  auto* b = static_cast<CBooster*>(handle);
+  if (tree_idx < 0 || tree_idx >= (int)b->trees.size())
+    return Fail("tree_idx out of range");
+  CTree& t = b->trees[tree_idx];
+  if (leaf_idx < 0 || leaf_idx >= t.num_leaves)
+    return Fail("leaf_idx out of range");
+  t.leaf_value[leaf_idx] = val;
+  t.PrepareShap();  // expected value depends on leaf values
+  // the loaded text no longer matches the edited model; SaveModel*
+  // return the error below rather than stale bytes
+  b->raw_model.clear();
   return 0;
 }
 

@@ -94,6 +94,20 @@ int LGBM_BoosterSaveModelToString(BoosterHandle handle,
                                   int feature_importance_type,
                                   int64_t buffer_len, int64_t* out_len,
                                   char* out_str);
+int LGBM_BoosterPredictForCSC(BoosterHandle handle, const void* col_ptr,
+                              int col_ptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result);
+int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                              int64_t* out_len);
+int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double* out_val);
+int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double val);
 int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int len,
                                 int* out_len, size_t buffer_len,
                                 size_t* out_buffer_len, char** out_strs);

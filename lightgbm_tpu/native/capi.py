@@ -85,6 +85,19 @@ _SIGNATURES = {
          ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
          ctypes.c_int, ctypes.c_int, ctypes.c_char_p, _p(ctypes.c_int64),
          _p(ctypes.c_double)],
+    "LGBM_BoosterPredictForCSC":
+        [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+         _p(ctypes.c_int32), ctypes.c_void_p, ctypes.c_int,
+         ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+         ctypes.c_int, ctypes.c_int, ctypes.c_char_p, _p(ctypes.c_int64),
+         _p(ctypes.c_double)],
+    "LGBM_BoosterGetNumPredict":
+        [ctypes.c_void_p, ctypes.c_int, _p(ctypes.c_int64)],
+    "LGBM_BoosterGetLeafValue":
+        [ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+         _p(ctypes.c_double)],
+    "LGBM_BoosterSetLeafValue":
+        [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_double],
     "LGBM_BoosterSaveModel":
         [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
          ctypes.c_char_p],

@@ -267,3 +267,53 @@ def test_c_example_end_to_end(tmp_path):
     bst = lgb.Booster(model_file=str(tmp_path / "model.txt"))
     np.testing.assert_allclose(preds_c, np.asarray(bst.predict(feats)),
                                rtol=1e-10)
+
+
+def test_csc_matches_dense(binary_model):
+    bst, X = binary_model
+    import ctypes
+
+    import scipy.sparse as sp
+    Xs = X[:40].copy()
+    Xs[np.abs(Xs) < 0.5] = 0.0
+    csc = sp.csc_matrix(Xs)
+    nb = NativeBooster(model_str=bst.model_to_string())
+    dense = nb.predict(Xs)
+    out = np.empty(40, dtype=np.float64)
+    out_len = ctypes.c_int64()
+    col_ptr = np.ascontiguousarray(csc.indptr, dtype=np.int64)
+    indices = np.ascontiguousarray(csc.indices, dtype=np.int32)
+    data = np.ascontiguousarray(csc.data, dtype=np.float64)
+    rc = nb._lib.LGBM_BoosterPredictForCSC(
+        nb._handle, col_ptr.ctypes.data_as(ctypes.c_void_p), 3,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.ctypes.data_as(ctypes.c_void_p), 1, len(col_ptr),
+        len(data), 40, C_API_PREDICT_NORMAL, 0, -1, b"",
+        ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0
+    np.testing.assert_allclose(out, dense[:, 0], rtol=1e-15)
+
+
+def test_leaf_value_get_set(binary_model):
+    bst, X = binary_model
+    import ctypes
+    nb = NativeBooster(model_str=bst.model_to_string())
+    v = ctypes.c_double()
+    assert nb._lib.LGBM_BoosterGetLeafValue(
+        nb._handle, 0, 1, ctypes.byref(v)) == 0
+    assert v.value == bst.inner.models[0].leaf_value[1]
+    # out-of-range errors, not crashes
+    assert nb._lib.LGBM_BoosterGetLeafValue(
+        nb._handle, 9999, 0, ctypes.byref(v)) != 0
+    # external leaf refit: set, predict reflects it, verbatim save gone
+    before = nb.predict(X[:5], predict_type=C_API_PREDICT_RAW_SCORE)
+    assert nb._lib.LGBM_BoosterSetLeafValue(
+        nb._handle, 0, 1, v.value + 1.0) == 0
+    after = nb.predict(X[:5], predict_type=C_API_PREDICT_RAW_SCORE)
+    leaf0 = nb.predict(X[:5], predict_type=C_API_PREDICT_LEAF_INDEX)[:, 0]
+    delta = np.where(leaf0 == 1, 1.0, 0.0)
+    np.testing.assert_allclose(after[:, 0] - before[:, 0], delta,
+                               atol=1e-12)
+    with pytest.raises(Exception):
+        nb.save_model_to_string()
