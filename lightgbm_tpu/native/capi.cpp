@@ -1027,3 +1027,142 @@ LGBM_EXPORT int LGBM_BoosterGetFeatureNames(void* handle, int len,
   }
   return 0;
 }
+
+// ---------------------------------------------------------------------
+// file prediction (reference: c_api.cpp LGBM_BoosterPredictForFile,
+// backing the CLI predict task): parse a CSV/TSV/LibSVM file with the
+// shared native parser and write one prediction line per row — a
+// complete C-only deployment pipeline with no Python runtime.
+#define PARSER_API __attribute__((visibility("hidden")))
+#include "parser.cpp"  // ParseDense/ParseLibSVM/FreeBuffer (same TU,
+                       // symbols hidden: _parser.so owns the exports)
+
+namespace {
+
+// format sniff mirroring the Python dispatch (application's loader):
+// the SECOND whitespace token of the first data line looking like
+// "idx:val" means LibSVM; otherwise the delimiter is , / tab / space.
+// The sniffed line skips the header row when the caller declared one.
+int SniffFormat(const char* path, int skip_header, char* delim) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  char buf[4096];
+  char* line = nullptr;
+  for (int i = 0; i <= (skip_header ? 1 : 0); ++i) {
+    line = std::fgets(buf, sizeof(buf), f);
+    if (!line) break;
+  }
+  std::fclose(f);
+  if (!line) return -1;
+  if (std::strchr(line, ',')) { *delim = ','; return 0; }
+  // whitespace format: LibSVM iff the second token carries ':'
+  const char* p = line;
+  while (*p && !std::isspace((unsigned char)*p)) ++p;   // token 0
+  while (*p && std::isspace((unsigned char)*p)) ++p;    // gap
+  const char* tok1 = p;
+  while (*p && !std::isspace((unsigned char)*p)) ++p;   // token 1
+  if (std::memchr(tok1, ':', p - tok1) != nullptr) return 1;
+  *delim = std::strchr(line, '\t') ? '\t' : ' ';
+  return 0;
+}
+
+}  // namespace
+
+LGBM_EXPORT int LGBM_BoosterPredictForFile(
+    void* handle, const char* data_filename, int data_has_header,
+    int predict_type, int start_iteration, int num_iteration,
+    const char* parameter, const char* result_filename) {
+  if (!handle || !data_filename || !result_filename)
+    return Fail("null argument");
+  // honored parameters: label_column=N (dense files carry the label at
+  // column N, CLI convention; default 0) and no_label=true. Anything
+  // else is rejected loudly — silently ignoring a reference parameter
+  // would mis-map columns.
+  long label_col = 0;
+  bool has_label = true;
+  if (parameter && *parameter) {
+    std::istringstream ps(parameter);
+    std::string tok;
+    while (ps >> tok) {
+      if (tok.rfind("label_column=", 0) == 0) {
+        label_col = std::atol(tok.c_str() + 13);
+      } else if (tok == "no_label=true" || tok == "has_label=false") {
+        has_label = false;
+      } else {
+        return Fail("unsupported predict parameter: " + tok);
+      }
+    }
+  }
+  auto* b = static_cast<CBooster*>(handle);
+  int nfeat = b->max_feature_idx + 1;
+  char delim = ',';
+  int kind = SniffFormat(data_filename, data_has_header, &delim);
+  if (kind < 0)
+    return Fail(std::string("cannot read ") + data_filename);
+  double* X = nullptr;
+  double* labels = nullptr;
+  long rows = 0, cols = 0;
+  int rc;
+  if (kind == 1) {
+    rc = ParseLibSVM(data_filename, &X, &labels, &rows, &cols);
+  } else {
+    rc = ParseDense(data_filename, delim, data_has_header ? 1 : 0,
+                    &X, &rows, &cols);
+  }
+  if (rc != 0) {
+    return Fail(std::string("cannot parse ") + data_filename);
+  }
+  // column accounting mirrors the Python predictor: dense files carry
+  // the label column (stripped unconditionally unless no_label=true);
+  // LibSVM files narrower than the model pad with zeros (sparse
+  // semantics: absent means 0). ParseLibSVM already splits labels out.
+  int64_t label_at = (kind == 0 && has_label) ? label_col : -1;
+  if (label_at >= cols) {
+    FreeBuffer(X);
+    FreeBuffer(labels);
+    return Fail("label_column is out of range for the data file");
+  }
+  int64_t data_cols = cols - (label_at >= 0 ? 1 : 0);
+  if (kind == 0 && data_cols != nfeat) {
+    FreeBuffer(X);
+    FreeBuffer(labels);
+    return Fail("the data file has a different number of features "
+                "than the model (see no_label/label_column "
+                "parameters)");
+  }
+  std::vector<double> row(nfeat, 0.0);
+  int t0, t1;
+  b->UsedRange(start_iteration, num_iteration, &t0, &t1);
+  int64_t stride = PredictOutputLen(b, 1, predict_type, t0, t1);
+  std::vector<double> out(stride);
+  std::ofstream rf(result_filename);
+  if (!rf) {
+    FreeBuffer(X);
+    FreeBuffer(labels);
+    return Fail(std::string("cannot write ") + result_filename);
+  }
+  ShapContext scratch;
+  char num[32];
+  for (long r = 0; r < rows; ++r) {
+    int64_t w = 0;
+    for (int64_t c = 0; c < cols && w < nfeat; ++c) {
+      if (c == label_at) continue;
+      row[w++] = X[r * cols + c];
+    }
+    for (; w < nfeat; ++w)
+      row[w] = (kind == 1) ? 0.0 : std::nan("");
+    PredictRowInto(b, row.data(), nfeat, predict_type, t0, t1,
+                   out.data(), &scratch);
+    for (int64_t j = 0; j < stride; ++j) {
+      std::snprintf(num, sizeof(num), "%.17g", out[j]);
+      rf << (j ? "\t" : "") << num;
+    }
+    rf << "\n";
+  }
+  FreeBuffer(X);
+  FreeBuffer(labels);
+  rf.flush();
+  if (!rf.good())
+    return Fail(std::string("write failed: ") + result_filename);
+  return 0;
+}

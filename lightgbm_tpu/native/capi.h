@@ -108,6 +108,12 @@ int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
                              int leaf_idx, double* out_val);
 int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
                              int leaf_idx, double val);
+int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                               const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int start_iteration, int num_iteration,
+                               const char* parameter,
+                               const char* result_filename);
 int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int len,
                                 int* out_len, size_t buffer_len,
                                 size_t* out_buffer_len, char** out_strs);

@@ -98,6 +98,9 @@ _SIGNATURES = {
          _p(ctypes.c_double)],
     "LGBM_BoosterSetLeafValue":
         [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_double],
+    "LGBM_BoosterPredictForFile":
+        [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+         ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p],
     "LGBM_BoosterSaveModel":
         [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
          ctypes.c_char_p],

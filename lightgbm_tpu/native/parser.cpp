@@ -19,6 +19,13 @@
 //     (absent entries 0.0, matching the reference's sparse semantics).
 //   FreeBuffer(ptr)
 
+// PARSER_API lets an including translation unit (native/capi.cpp pulls
+// this file in for PredictForFile) make these symbols hidden instead of
+// re-exporting duplicates of _parser.so's interface
+#ifndef PARSER_API
+#define PARSER_API
+#endif
+
 #include <cctype>
 #include <cstdio>
 #include <cstdlib>
@@ -54,10 +61,10 @@ inline const char* SkipSpaces(const char* p, const char* end) {
 
 extern "C" {
 
-void FreeBuffer(void* p) { std::free(p); }
+PARSER_API void FreeBuffer(void* p) { std::free(p); }
 
 // status: 0 ok, 1 io error, 2 empty/parse error
-int ParseDense(const char* path, char delim, int skip_rows,
+PARSER_API int ParseDense(const char* path, char delim, int skip_rows,
                double** out, long* n_rows, long* n_cols) {
   std::string buf;
   if (!ReadAll(path, &buf)) return 1;
@@ -138,7 +145,7 @@ int ParseDense(const char* path, char delim, int skip_rows,
   return 0;
 }
 
-int ParseLibSVM(const char* path, double** out, double** labels,
+PARSER_API int ParseLibSVM(const char* path, double** out, double** labels,
                 long* n_rows, long* n_cols) {
   std::string buf;
   if (!ReadAll(path, &buf)) return 1;
@@ -208,7 +215,7 @@ int ParseLibSVM(const char* path, double** out, double** labels,
 //
 // out must have room for max_bin + 1 doubles; returns the number of
 // bounds written (the last one is +inf).
-int GreedyFindBin(const double* distinct_values, const double* counts,
+PARSER_API int GreedyFindBin(const double* distinct_values, const double* counts,
                   long num_distinct, int max_bin, double total_cnt,
                   int min_data_in_bin, double* out) {
   const double kInf = std::numeric_limits<double>::infinity();

@@ -317,3 +317,69 @@ def test_leaf_value_get_set(binary_model):
                                atol=1e-12)
     with pytest.raises(Exception):
         nb.save_model_to_string()
+
+
+def test_predict_for_file(binary_model, tmp_path):
+    """C-only deployment pipeline: predict straight from a CSV file
+    (label column in front, CLI convention) and from LibSVM, no Python
+    in the loop."""
+    bst, X = binary_model
+    nb = NativeBooster(model_str=bst.model_to_string())
+    expect = np.asarray(bst.predict(X[:50]))
+    # CSV with label column
+    data = tmp_path / "rows.csv"
+    y0 = np.zeros((50, 1))
+    np.savetxt(data, np.hstack([y0, X[:50]]), delimiter=",", fmt="%.10g")
+    out = tmp_path / "preds.txt"
+    rc = nb._lib.LGBM_BoosterPredictForFile(
+        nb._handle, str(data).encode(), 0, C_API_PREDICT_NORMAL, 0, -1,
+        b"", str(out).encode())
+    assert rc == 0
+    got = np.loadtxt(out)
+    np.testing.assert_allclose(got, expect, rtol=1e-12)
+    # LibSVM (narrower than the model pads with zeros)
+    svm = tmp_path / "rows.svm"
+    with open(svm, "w") as f:
+        for i in range(50):
+            feats = " ".join("%d:%.10g" % (j, X[i, j])
+                             for j in range(4) if X[i, j] != 0.0)
+            f.write("0 %s\n" % feats)
+    Xp = X[:50].copy()
+    Xp[:, 4:] = 0.0
+    expect_svm = np.asarray(bst.predict(Xp))
+    out2 = tmp_path / "preds2.txt"
+    assert nb._lib.LGBM_BoosterPredictForFile(
+        nb._handle, str(svm).encode(), 0, C_API_PREDICT_NORMAL, 0, -1,
+        b"", str(out2).encode()) == 0
+    np.testing.assert_allclose(np.loadtxt(out2), expect_svm, rtol=1e-12)
+
+
+def test_predict_for_file_parameters(binary_model, tmp_path):
+    bst, X = binary_model
+    nb = NativeBooster(model_str=bst.model_to_string())
+    expect = np.asarray(bst.predict(X[:20]))
+    # features-only file needs no_label=true
+    data = tmp_path / "feat.csv"
+    np.savetxt(data, X[:20], delimiter=",", fmt="%.10g")
+    out = tmp_path / "p.txt"
+    assert nb._lib.LGBM_BoosterPredictForFile(
+        nb._handle, str(data).encode(), 0, C_API_PREDICT_NORMAL, 0, -1,
+        b"no_label=true", str(out).encode()) == 0
+    np.testing.assert_allclose(np.loadtxt(out), expect, rtol=1e-12)
+    # without the parameter, the width mismatch is a loud error
+    assert nb._lib.LGBM_BoosterPredictForFile(
+        nb._handle, str(data).encode(), 0, C_API_PREDICT_NORMAL, 0, -1,
+        b"", str(out).encode()) != 0
+    # label in the last column
+    data2 = tmp_path / "tail.csv"
+    np.savetxt(data2, np.hstack([X[:20], np.zeros((20, 1))]),
+               delimiter=",", fmt="%.10g")
+    assert nb._lib.LGBM_BoosterPredictForFile(
+        nb._handle, str(data2).encode(), 0, C_API_PREDICT_NORMAL, 0, -1,
+        ("label_column=%d" % X.shape[1]).encode(),
+        str(out).encode()) == 0
+    np.testing.assert_allclose(np.loadtxt(out), expect, rtol=1e-12)
+    # unsupported parameters are rejected, not silently dropped
+    assert nb._lib.LGBM_BoosterPredictForFile(
+        nb._handle, str(data).encode(), 0, C_API_PREDICT_NORMAL, 0, -1,
+        b"two_round=true", str(out).encode()) != 0
