@@ -24,6 +24,7 @@
 //  - random-forest score averaging (average_output header flag)
 //
 // Build: g++ -O2 -shared -fPIC -std=c++17 -o _capi.so capi.cpp
+#include <algorithm>
 #include <cctype>
 #include <cmath>
 #include <cstdint>
@@ -67,6 +68,10 @@ struct CTree {
   std::vector<uint32_t> cat_threshold;
   // data-coverage weights for SHAP (reference: tree.h data_count(node))
   std::vector<double> leaf_count, internal_count;
+  // kept for DumpModel JSON (absent lines stay empty -> zeros)
+  std::vector<double> split_gain, internal_value, internal_weight,
+      leaf_weight;
+  double shrinkage = 1.0;
   // prepared once at load time (PrepareShap): clamped coverage weights
   // (mirroring models/shap.py _node_count's max(count, 1)), the
   // cover-weighted expected value, and the flat-path capacity
@@ -214,6 +219,10 @@ struct CBooster {
   bool average_output = false;
   Transform transform = kIdentity;
   double sigmoid = 1.0;
+  int label_index = 0;
+  std::string objective_str;
+  std::vector<int> monotone_constraints;
+  std::vector<std::string> feature_infos;
   std::vector<std::string> feature_names;
   std::vector<CTree> trees;
   std::string raw_model;  // original text, for SaveModel round-trip
@@ -336,6 +345,14 @@ bool ParseTree(const std::map<std::string, std::string>& kv, CTree* t,
       t->leaf_count = ParseArray<double>(*get("leaf_count"));
     if (get("internal_count"))
       t->internal_count = ParseArray<double>(*get("internal_count"));
+    if (get("split_gain"))
+      t->split_gain = ParseArray<double>(*get("split_gain"));
+    if (get("internal_value"))
+      t->internal_value = ParseArray<double>(*get("internal_value"));
+    if (get("internal_weight"))
+      t->internal_weight = ParseArray<double>(*get("internal_weight"));
+    if (get("leaf_weight"))
+      t->leaf_weight = ParseArray<double>(*get("leaf_weight"));
     // cat nodes keep the cat-split index in `threshold`
     t->threshold_in_bin.assign(ni, 0);
     if (get("cat_boundaries")) {
@@ -361,6 +378,7 @@ bool ParseTree(const std::map<std::string, std::string>& kv, CTree* t,
       }
     }
   }
+  if (get("shrinkage")) t->shrinkage = std::atof(get("shrinkage")->c_str());
   const std::string* lin = get("is_linear");
   if (lin && std::atoi(lin->c_str())) {
     if (!get("leaf_const")) {
@@ -447,8 +465,18 @@ CBooster* LoadFromString(const std::string& s, std::string* err) {
     else if (k == "num_tree_per_iteration")
       b->num_tree_per_iteration = std::atoi(v.c_str());
     else if (k == "max_feature_idx") b->max_feature_idx = std::atoi(v.c_str());
+    else if (k == "label_index") b->label_index = std::atoi(v.c_str());
     else if (k == "objective") {
+      b->objective_str = v;
       if (!SetObjective(v, b.get(), err)) return nullptr;
+    } else if (k == "monotone_constraints") {
+      std::istringstream ms(v);
+      int mc;
+      while (ms >> mc) b->monotone_constraints.push_back(mc);
+    } else if (k == "feature_infos") {
+      std::istringstream fs(v);
+      std::string info;
+      while (fs >> info) b->feature_infos.push_back(info);
     } else if (k == "feature_names") {
       std::istringstream ns(v);
       std::string n;
@@ -1044,25 +1072,21 @@ namespace {
 // "idx:val" means LibSVM; otherwise the delimiter is , / tab / space.
 // The sniffed line skips the header row when the caller declared one.
 int SniffFormat(const char* path, int skip_header, char* delim) {
-  FILE* f = std::fopen(path, "rb");
+  std::ifstream f(path, std::ios::binary);
   if (!f) return -1;
-  char buf[4096];
-  char* line = nullptr;
+  std::string line;
   for (int i = 0; i <= (skip_header ? 1 : 0); ++i) {
-    line = std::fgets(buf, sizeof(buf), f);
-    if (!line) break;
+    if (!std::getline(f, line)) return -1;
   }
-  std::fclose(f);
-  if (!line) return -1;
-  if (std::strchr(line, ',')) { *delim = ','; return 0; }
+  if (line.find(',') != std::string::npos) { *delim = ','; return 0; }
   // whitespace format: LibSVM iff the second token carries ':'
-  const char* p = line;
+  const char* p = line.c_str();
   while (*p && !std::isspace((unsigned char)*p)) ++p;   // token 0
   while (*p && std::isspace((unsigned char)*p)) ++p;    // gap
   const char* tok1 = p;
   while (*p && !std::isspace((unsigned char)*p)) ++p;   // token 1
   if (std::memchr(tok1, ':', p - tok1) != nullptr) return 1;
-  *delim = std::strchr(line, '\t') ? '\t' : ' ';
+  *delim = line.find('\t') != std::string::npos ? '\t' : ' ';
   return 0;
 }
 
@@ -1085,7 +1109,13 @@ LGBM_EXPORT int LGBM_BoosterPredictForFile(
     std::string tok;
     while (ps >> tok) {
       if (tok.rfind("label_column=", 0) == 0) {
-        label_col = std::atol(tok.c_str() + 13);
+        const char* v = tok.c_str() + 13;
+        char* endp = nullptr;
+        label_col = std::strtol(v, &endp, 10);
+        if (endp == v || *endp != '\0' || label_col < 0)
+          return Fail("label_column must be a column index (the "
+                      "name: syntax needs the Python front end): "
+                      + tok);
       } else if (tok == "no_label=true" || tok == "has_label=false") {
         has_label = false;
       } else {
@@ -1099,6 +1129,8 @@ LGBM_EXPORT int LGBM_BoosterPredictForFile(
   int kind = SniffFormat(data_filename, data_has_header, &delim);
   if (kind < 0)
     return Fail(std::string("cannot read ") + data_filename);
+  if (kind == 1 && data_has_header)
+    return Fail("LibSVM files have no header line");
   double* X = nullptr;
   double* labels = nullptr;
   long rows = 0, cols = 0;
@@ -1164,5 +1196,264 @@ LGBM_EXPORT int LGBM_BoosterPredictForFile(
   rf.flush();
   if (!rf.good())
     return Fail(std::string("write failed: ") + result_filename);
+  return 0;
+}
+
+// ---------------------------------------------------------------------
+// JSON model dump (reference: c_api.cpp LGBM_BoosterDumpModel ->
+// GBDT::DumpModel, gbdt_model_text.cpp:21-170) — same structure as the
+// Python runtime's dump_model() so R/Java hosts parse one schema.
+namespace {
+
+void JsonNum(std::string* out, double v) {
+  if (std::isfinite(v)) {
+    char buf[32];
+    std::snprintf(buf, sizeof(buf), "%.17g", v);
+    *out += buf;
+  } else {
+    *out += v > 0 ? "\"inf\"" : (v < 0 ? "\"-inf\"" : "\"nan\"");
+  }
+}
+
+void JsonStr(std::string* out, const std::string& s) {
+  *out += '"';
+  for (char c : s) {
+    if (c == '"' || c == '\\') { *out += '\\'; *out += c; }
+    else if ((unsigned char)c < 0x20) {
+      char buf[8];
+      std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+      *out += buf;
+    } else *out += c;
+  }
+  *out += '"';
+}
+
+double TreeField(const std::vector<double>& a, int i) {
+  return i < (int)a.size() ? a[i] : 0.0;
+}
+
+void AppendLinearLeaf(const CTree& t, int leaf, std::string* j) {
+  *j += ",\"leaf_const\":";
+  JsonNum(j, TreeField(t.leaf_const, leaf));
+  *j += ",\"leaf_features\":[";
+  const auto& feats = t.leaf_features[leaf];
+  for (size_t i = 0; i < feats.size(); ++i) {
+    if (i) *j += ",";
+    *j += std::to_string(feats[i]);
+  }
+  *j += "],\"leaf_coeff\":[";
+  const auto& coef = t.leaf_coeff[leaf];
+  for (size_t i = 0; i < coef.size(); ++i) {
+    if (i) *j += ",";
+    JsonNum(j, coef[i]);
+  }
+  *j += "]";
+}
+
+void NodeToJson(const CTree& t, int index, std::string* out) {
+  // iterative post-order with memoized child strings (chain trees can
+  // be num_leaves-1 deep; mirror models/tree.py _node_to_json)
+  std::map<int, std::string> memo;
+  std::vector<int> order, stack{index};
+  while (!stack.empty()) {
+    int idx = stack.back();
+    stack.pop_back();
+    order.push_back(idx);
+    if (idx >= 0) {
+      stack.push_back(t.left_child[idx]);
+      stack.push_back(t.right_child[idx]);
+    }
+  }
+  for (auto it = order.rbegin(); it != order.rend(); ++it) {
+    int idx = *it;
+    std::string j;
+    if (idx < 0) {
+      int leaf = ~idx;
+      j += "{\"leaf_index\":" + std::to_string(leaf) + ",\"leaf_value\":";
+      JsonNum(&j, t.leaf_value[leaf]);
+      j += ",\"leaf_weight\":";
+      JsonNum(&j, TreeField(t.leaf_weight, leaf));
+      j += ",\"leaf_count\":"
+           + std::to_string((long long)TreeField(t.leaf_count, leaf));
+      if (t.is_linear) AppendLinearLeaf(t, leaf, &j);
+      j += "}";
+    } else {
+      int dt = t.decision_type[idx];
+      j += "{\"split_index\":" + std::to_string(idx);
+      j += ",\"split_feature\":" + std::to_string(t.split_feature[idx]);
+      j += ",\"split_gain\":";
+      JsonNum(&j, TreeField(t.split_gain, idx));
+      j += ",\"threshold\":";
+      if (dt & kCategoricalMask) {
+        // expand the bitset back to "a||b||c" (reference NodeToJSON)
+        int ci = t.threshold_in_bin[idx];
+        std::string cats;
+        int64_t lo = t.cat_boundaries[ci], hi = t.cat_boundaries[ci + 1];
+        for (int64_t w = 0; w < hi - lo; ++w) {
+          uint32_t word = t.cat_threshold[lo + w];
+          for (int bit = 0; bit < 32; ++bit) {
+            if ((word >> bit) & 1u) {
+              if (!cats.empty()) cats += "||";
+              cats += std::to_string(w * 32 + bit);
+            }
+          }
+        }
+        JsonStr(&j, cats);
+        j += ",\"decision_type\":\"==\"";
+      } else {
+        JsonNum(&j, t.threshold[idx]);
+        j += ",\"decision_type\":\"<=\"";
+      }
+      int missing = (dt >> 2) & 3;
+      j += std::string(",\"default_left\":")
+           + ((dt & kDefaultLeftMask) ? "true" : "false");
+      j += std::string(",\"missing_type\":\"")
+           + (missing == kMissingZero ? "Zero"
+              : missing == kMissingNaN ? "NaN" : "None") + "\"";
+      j += ",\"internal_value\":";
+      JsonNum(&j, TreeField(t.internal_value, idx));
+      j += ",\"internal_weight\":";
+      JsonNum(&j, TreeField(t.internal_weight, idx));
+      j += ",\"internal_count\":"
+           + std::to_string((long long)TreeField(t.internal_count, idx));
+      auto lit = memo.find(t.left_child[idx]);
+      auto rit = memo.find(t.right_child[idx]);
+      j += ",\"left_child\":" + std::move(lit->second);
+      j += ",\"right_child\":" + std::move(rit->second);
+      memo.erase(lit);
+      memo.erase(rit);
+      j += "}";
+    }
+    memo[idx] = std::move(j);
+  }
+  *out += memo[index];
+}
+
+}  // namespace
+
+LGBM_EXPORT int LGBM_BoosterDumpModel(void* handle, int start_iteration,
+                                      int num_iteration,
+                                      int feature_importance_type,
+                                      int64_t buffer_len,
+                                      int64_t* out_len, char* out_str) {
+  if (!handle || !out_len) return Fail("null argument");
+  auto* b = static_cast<CBooster*>(handle);
+  int t0, t1;
+  b->UsedRange(start_iteration, num_iteration, &t0, &t1);
+  std::string j = "{\"name\":\"tree\",\"version\":\"v3\"";
+  j += ",\"num_class\":" + std::to_string(b->num_class);
+  j += ",\"num_tree_per_iteration\":"
+       + std::to_string(b->num_tree_per_iteration);
+  j += ",\"label_index\":" + std::to_string(b->label_index);
+  j += ",\"max_feature_idx\":" + std::to_string(b->max_feature_idx);
+  if (!b->objective_str.empty()) {
+    j += ",\"objective\":";
+    JsonStr(&j, b->objective_str);
+  }
+  j += std::string(",\"average_output\":")
+       + (b->average_output ? "true" : "false");
+  j += ",\"feature_names\":[";
+  for (size_t i = 0; i < b->feature_names.size(); ++i) {
+    if (i) j += ",";
+    JsonStr(&j, b->feature_names[i]);
+  }
+  j += "],\"feature_infos\":{";
+  {
+    bool first = true;
+    for (size_t i = 0; i < b->feature_infos.size()
+                       && i < b->feature_names.size(); ++i) {
+      const std::string& info = b->feature_infos[i];
+      if (info == "none") continue;
+      if (!first) j += ",";
+      first = false;
+      JsonStr(&j, b->feature_names[i]);
+      j += ":{\"min_value\":";
+      if (!info.empty() && info.front() == '[') {
+        auto colon = info.find(':');
+        JsonNum(&j, std::atof(info.substr(1, colon - 1).c_str()));
+        j += ",\"max_value\":";
+        JsonNum(&j, std::atof(
+            info.substr(colon + 1, info.size() - colon - 2).c_str()));
+        j += ",\"values\":[]}";
+      } else {
+        // categorical: colon-separated category values
+        std::vector<long> vals;
+        std::istringstream vs(info);
+        std::string tokv;
+        while (std::getline(vs, tokv, ':'))
+          vals.push_back(std::atol(tokv.c_str()));
+        long mn = vals.empty() ? 0 : *std::min_element(vals.begin(),
+                                                       vals.end());
+        long mx = vals.empty() ? 0 : *std::max_element(vals.begin(),
+                                                       vals.end());
+        j += std::to_string(mn) + ",\"max_value\":"
+             + std::to_string(mx) + ",\"values\":[";
+        for (size_t vI = 0; vI < vals.size(); ++vI) {
+          if (vI) j += ",";
+          j += std::to_string(vals[vI]);
+        }
+        j += "]}";
+      }
+    }
+  }
+  j += "},\"monotone_constraints\":[";
+  for (size_t i = 0; i < b->monotone_constraints.size(); ++i) {
+    if (i) j += ",";
+    j += std::to_string(b->monotone_constraints[i]);
+  }
+  j += "],\"tree_info\":[";
+  for (int i = t0; i < t1; ++i) {
+    if (i > t0) j += ",";
+    const CTree& t = b->trees[i];
+    j += "{\"tree_index\":" + std::to_string(i - t0);
+    j += ",\"num_leaves\":" + std::to_string(t.num_leaves);
+    j += ",\"num_cat\":"
+         + std::to_string((long long)(t.cat_boundaries.empty()
+                                      ? 0 : t.cat_boundaries.size() - 1));
+    j += ",\"shrinkage\":";
+    JsonNum(&j, t.shrinkage);
+    j += ",\"tree_structure\":";
+    if (t.num_leaves == 1) {
+      j += "{\"leaf_value\":";
+      JsonNum(&j, t.leaf_value.empty() ? 0.0 : t.leaf_value[0]);
+      if (t.is_linear) AppendLinearLeaf(t, 0, &j);
+      j += "}";
+    } else {
+      NodeToJson(t, 0, &j);
+    }
+    j += "}";
+  }
+  j += "],\"feature_importances\":{";
+  {
+    int nfeat = b->max_feature_idx + 1;
+    std::vector<double> imp(nfeat, 0.0);
+    // the Python runtime and the reference count from tree 0 through
+    // the last used iteration regardless of start_iteration
+    for (int i = 0; i < t1; ++i) {
+      const CTree& t = b->trees[i];
+      for (int k = 0; k < t.num_leaves - 1; ++k) {
+        if (t.split_feature[k] < nfeat) {
+          imp[t.split_feature[k]] +=
+              feature_importance_type == 1
+                  ? std::max(TreeField(t.split_gain, k), 0.0)
+                  : 1.0;
+        }
+      }
+    }
+    bool first = true;
+    for (int f = 0; f < nfeat && f < (int)b->feature_names.size(); ++f) {
+      if (imp[f] <= 0) continue;
+      if (!first) j += ",";
+      first = false;
+      JsonStr(&j, b->feature_names[f]);
+      j += ":";
+      if (feature_importance_type == 1) JsonNum(&j, imp[f]);
+      else j += std::to_string((long long)imp[f]);
+    }
+  }
+  j += "}}";
+  *out_len = static_cast<int64_t>(j.size()) + 1;
+  if (out_str && buffer_len >= *out_len)
+    std::memcpy(out_str, j.c_str(), *out_len);
   return 0;
 }

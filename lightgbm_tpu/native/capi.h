@@ -114,6 +114,10 @@ int LGBM_BoosterPredictForFile(BoosterHandle handle,
                                int start_iteration, int num_iteration,
                                const char* parameter,
                                const char* result_filename);
+int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int feature_importance_type,
+                          int64_t buffer_len, int64_t* out_len,
+                          char* out_str);
 int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int len,
                                 int* out_len, size_t buffer_len,
                                 size_t* out_buffer_len, char** out_strs);

@@ -101,6 +101,9 @@ _SIGNATURES = {
     "LGBM_BoosterPredictForFile":
         [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
          ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p],
+    "LGBM_BoosterDumpModel":
+        [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+         ctypes.c_int64, _p(ctypes.c_int64), ctypes.c_char_p],
     "LGBM_BoosterSaveModel":
         [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
          ctypes.c_char_p],
@@ -232,6 +235,16 @@ class NativeBooster:
             start_iteration, num_iteration, b"", ctypes.byref(out_len),
             out.ctypes.data_as(_p(ctypes.c_double))))
         return out.reshape(nrow, -1)
+
+    def dump_model(self) -> dict:
+        import json
+        n = ctypes.c_int64()
+        _check(self._lib, self._lib.LGBM_BoosterDumpModel(
+            self._handle, 0, -1, 0, 0, ctypes.byref(n), None))
+        buf = ctypes.create_string_buffer(n.value)
+        _check(self._lib, self._lib.LGBM_BoosterDumpModel(
+            self._handle, 0, -1, 0, n.value, ctypes.byref(n), buf))
+        return json.loads(buf.value.decode())
 
     def save_model_to_string(self) -> str:
         n = ctypes.c_int64()

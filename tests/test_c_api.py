@@ -383,3 +383,30 @@ def test_predict_for_file_parameters(binary_model, tmp_path):
     assert nb._lib.LGBM_BoosterPredictForFile(
         nb._handle, str(data).encode(), 0, C_API_PREDICT_NORMAL, 0, -1,
         b"two_round=true", str(out).encode()) != 0
+
+
+def test_dump_model_matches_python():
+    rng = np.random.RandomState(17)
+    X = rng.randn(500, 6)
+    X[:, 3] = rng.randint(0, 6, 500)
+    y = ((X[:, 0] > 0) ^ (X[:, 3] == 2)).astype(float)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[3])
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "min_data_in_leaf": 5,
+                     "monotone_constraints": [1, 0, 0, 0, 0, 0]},
+                    ds, num_boost_round=6)
+    nb = NativeBooster(model_str=bst.model_to_string())
+    # identical schema and values, feature_infos included (floats
+    # compare exactly: both sides write round-trip representations)
+    assert nb.dump_model() == bst.dump_model()
+
+
+def test_dump_model_linear_matches_python():
+    rng = np.random.RandomState(19)
+    X = rng.randn(500, 4)
+    y = 2 * X[:, 0] + X[:, 1] + 0.05 * rng.randn(500)
+    bst = lgb.train({"objective": "regression", "linear_tree": True,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    nb = NativeBooster(model_str=bst.model_to_string())
+    assert nb.dump_model() == bst.dump_model()
