@@ -108,13 +108,24 @@ def train(params: Dict[str, Any], train_set: Dataset,
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
     # tpu_batch_iterations: run N iterations per device dispatch
-    # (gbdt.py train_batch). Callbacks, eval sets, and custom objectives
-    # observe every iteration, so batching only engages without them.
+    # (gbdt.py train_batch). Evaluation and callbacks then fire at
+    # BATCH boundaries — early stopping still measures its patience in
+    # iterations (env.iteration advances by N), just checked N at a
+    # time. Custom objectives are excluded by can_train_batched.
     batch_n = int(cfg.tpu_batch_iterations)
-    if batch_n > 1 and not (callbacks or valid_sets
-                            or eval_train_requested or fobj):
+    if batch_n > 1 and fobj is None:
+        if callbacks or valid_sets:
+            log.info("tpu_batch_iterations=%d: evaluation/callbacks "
+                     "run every %d iterations (batch boundaries)"
+                     % (batch_n, batch_n))
         i = 0
-        while i < num_boost_round:
+        degraded = False
+        while i < num_boost_round and not degraded:
+            for cb in callbacks_before:
+                cb(callback_mod.CallbackEnv(
+                    model=booster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=None))
             if (booster.inner.can_train_batched()
                     and num_boost_round - i >= batch_n):
                 # full batches only: a shorter tail scan would recompile
@@ -124,31 +135,57 @@ def train(params: Dict[str, Any], train_set: Dataset,
             else:
                 finished = booster.update(fobj=fobj)
                 i += 1
-                if finished:
-                    break
-                if not booster.inner.can_train_batched():
-                    # permanently ineligible config: fall through to the
-                    # plain loop without re-checking every iteration
+                if not finished and not booster.inner.can_train_batched():
+                    # permanently ineligible config: the plain loop
+                    # below takes over (per-iteration evaluation) after
+                    # this iteration's own evaluation below runs
                     log.warning(
                         "tpu_batch_iterations=%d ignored: the "
                         "configuration needs per-iteration host work "
                         "(sampling/monotone/CEGB/linear/renewal, a "
                         "stochastic-gradient objective, or a "
                         "multi-process learner)" % batch_n)
-                    for _ in range(i, num_boost_round):
-                        if booster.update(fobj=fobj):
-                            break
-                    break
+                    degraded = True
+            evaluation_result_list = []
+            if valid_sets or eval_train_requested:
+                if eval_train_requested:
+                    evaluation_result_list.extend(
+                        booster.eval_train(feval))
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in callbacks_after:
+                    cb(callback_mod.CallbackEnv(
+                        model=booster, params=params, iteration=i - 1,
+                        begin_iteration=0,
+                        end_iteration=num_boost_round,
+                        evaluation_result_list=evaluation_result_list))
+            except callback_mod.EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                for item in (e.best_score or []):
+                    booster.best_score.setdefault(
+                        item[0], {})[item[1]] = item[2]
+                return booster
             if finished:
                 break
-        booster.best_iteration = booster.current_iteration
-        return booster
-    elif batch_n > 1:
-        log.warning("tpu_batch_iterations=%d ignored: callbacks, valid "
-                    "sets, or a custom objective need per-iteration "
-                    "evaluation" % batch_n)
+        if not degraded:
+            if booster.best_iteration <= 0:
+                booster.best_iteration = booster.current_iteration
+                for item in (evaluation_result_list
+                             if valid_sets and i > 0 else []):
+                    booster.best_score.setdefault(
+                        item[0], {})[item[1]] = item[2]
+            return booster
+        # fall through to the plain per-iteration loop from iteration i
+        start_i = i
+    else:
+        start_i = 0
+        if batch_n > 1:
+            log.warning("tpu_batch_iterations=%d ignored: a custom "
+                        "objective needs per-iteration gradients"
+                        % batch_n)
 
-    for i in range(num_boost_round):
+    evaluation_result_list = []
+    for i in range(start_i, num_boost_round):
         for cb in callbacks_before:
             cb(callback_mod.CallbackEnv(
                 model=booster, params=params, iteration=i,

@@ -166,7 +166,7 @@ def test_engine_tpu_batch_iterations():
     assert a.current_iteration == 7
 
 
-def test_engine_batch_knob_falls_back_with_callbacks():
+def test_engine_batch_callbacks_at_batch_boundaries():
     rng = np.random.RandomState(22)
     X = rng.randn(600, 6)
     y = (X[:, 0] > 0).astype(float)
@@ -178,11 +178,32 @@ def test_engine_batch_knob_falls_back_with_callbacks():
     bst = lgb.train({"objective": "binary", "verbosity": -1,
                      "tpu_batch_iterations": 4, "num_leaves": 15,
                      "tree_learner": "data", "mesh_shape": "data=1"},
-                    lgb.Dataset(X, label=y), num_boost_round=6,
+                    lgb.Dataset(X, label=y), num_boost_round=9,
                     callbacks=[cb])
-    # callbacks force the per-iteration loop: one env per iteration
-    assert seen == list(range(6))
-    assert len(bst.inner.models) == 6
+    # iteration 0 runs per-iteration (boost_from_average), then full
+    # batches of 4; callbacks fire at batch ends with the LAST
+    # iteration index of the batch
+    assert seen == [0, 4, 8]
+    assert len(bst.inner.models) == 9
+
+
+def test_engine_batch_early_stopping():
+    rng = np.random.RandomState(25)
+    X = rng.randn(1500, 6)
+    y = (X[:, 0] + 0.3 * rng.randn(1500) > 0).astype(float)
+    Xv = rng.randn(400, 6)
+    yv = (Xv[:, 0] + 0.3 * rng.randn(400) > 0).astype(float)
+    tr = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                     "verbosity": -1, "num_leaves": 15,
+                     "tpu_batch_iterations": 5,
+                     "tree_learner": "data", "mesh_shape": "data=1"},
+                    tr, num_boost_round=200,
+                    valid_sets=[lgb.Dataset(Xv, label=yv, reference=tr)],
+                    callbacks=[lgb.early_stopping(10, verbose=False)])
+    # stopped long before 200 rounds, with a recorded best iteration
+    assert 0 < bst.best_iteration < 200
+    assert bst.current_iteration < 200
 
 
 def test_engine_batch_knob_falls_back_when_ineligible():
@@ -246,3 +267,22 @@ def test_multiclass_batched_matches_looped(objective):
     pred_a = np.asarray(a.predict(X, raw_score=True))
     score_a = np.asarray(a.inner.train_score, dtype=np.float64)
     np.testing.assert_allclose(score_a, pred_a, atol=1e-5)
+
+
+def test_engine_batch_best_score_without_early_stopping():
+    rng = np.random.RandomState(27)
+    X = rng.randn(800, 6)
+    y = (X[:, 0] > 0).astype(float)
+    Xv = rng.randn(200, 6)
+    yv = (Xv[:, 0] > 0).astype(float)
+    tr = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                     "verbosity": -1, "num_leaves": 15,
+                     "tpu_batch_iterations": 4,
+                     "tree_learner": "data", "mesh_shape": "data=1"},
+                    tr, num_boost_round=9,
+                    valid_sets=[lgb.Dataset(Xv, label=yv, reference=tr)])
+    # same public contract as the per-iteration loop: final eval fills
+    # best_score even with no early stopping
+    assert bst.best_iteration == 9
+    assert "binary_logloss" in bst.best_score.get("valid_0", {})
