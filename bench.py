@@ -72,9 +72,18 @@ def _reexec_on_cpu(reason: str) -> None:
     env = scrubbed_cpu_env()
     env["BENCH_CHILD"] = "1"
     env["BENCH_FALLBACK"] = reason
-    env.setdefault("BENCH_ROWS", "200000")
-    env.setdefault("BENCH_ITERS", "120")
-    env.setdefault("BENCH_TIME_BUDGET", "420")
+    # measure at FULL Higgs scale even on CPU: gen+bin+warmup ~4.5 min
+    # (measured: 9+29+219 s single-core), then steady-state batched
+    # iterations — an honest nonzero vs_baseline beats a small-row
+    # number that must report 0. The budget is FORCED (not setdefault):
+    # the fallback runs inside outer timeouts (revival watcher, driver)
+    # sized for the accelerator path, and the post-batch budget check
+    # can overshoot by one batch (~4 min at batch=4 single-core), so
+    # worst-case wall must stay well under those timeouts:
+    # probes ~900s + gen/bin ~260s + budget 600s + one batch ~220s.
+    env.setdefault("BENCH_ITERS", "21")
+    env.setdefault("BENCH_TREE_BATCH", "4")
+    env["BENCH_TIME_BUDGET"] = "600"
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
               env)
 
@@ -301,10 +310,10 @@ def _run_escalating(platform: str) -> dict:
     clients, so a parent-held device can't starve them."""
     if platform == "cpu":
         if "BENCH_ROWS" not in os.environ:
-            # a full-Higgs CPU run takes hours on one core; cap the
-            # default so a CPU-only environment still reports a number
-            os.environ["BENCH_ROWS"] = "200000"
-            os.environ.setdefault("BENCH_ITERS", "120")
+            # full scale on CPU too: ~5 min of setup, then steady-state
+            # batched iterations; vs_baseline stays honest (nonzero)
+            os.environ.setdefault("BENCH_ITERS", "21")
+            os.environ.setdefault("BENCH_TREE_BATCH", "4")
         return run_bench()
     target = int(os.environ.get("BENCH_ROWS", HIGGS_ROWS))
     budget = float(os.environ.get("BENCH_TIME_BUDGET", 2400))

@@ -6,6 +6,6 @@ LOG=/tmp/tpu_bench_on_revival.log
 while [ ! -f "$MARKER" ]; do sleep 60; done
 date +"%F %T tunnel alive - running bench" >> "$LOG"
 cd /root/repo
-BENCH_TIME_BUDGET=1800 timeout 2400 python bench.py \
+BENCH_TIME_BUDGET=2400 timeout 4800 python bench.py \
   > /root/repo/TPU_BENCH_EVIDENCE.json 2>> "$LOG"
 date +"%F %T bench done rc=$?" >> "$LOG"
