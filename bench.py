@@ -112,8 +112,16 @@ def make_higgs_like(n_rows: int, n_features: int = 28, seed: int = 0):
 def _stage(name: str, **kw) -> None:
     """Append a stage record so a late failure still leaves evidence
     (bench_stages.jsonl next to this file; round-4 verdict: the
-    all-or-nothing probe lost two rounds of partial results)."""
-    rec = dict(stage=name, t=time.time(), **kw)
+    all-or-nothing probe lost two rounds of partial results). Each
+    record carries peak RSS (MB) — the reference publishes Higgs peak
+    RAM (docs/Experiments.rst:166, 0.897 GB col-wise) so memory is part
+    of the comparison."""
+    try:
+        import resource
+        rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+    except Exception:
+        rss_mb = -1
+    rec = dict(stage=name, t=time.time(), rss_mb=rss_mb, **kw)
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "bench_stages.jsonl")
     try:
