@@ -19,6 +19,8 @@ from .gbdt import GBDT
 
 class DART(GBDT):
     submodel_name = "tree"  # same model format
+    # per-iteration drop selection + renormalization are host logic
+    _supports_batched = False
 
     def __init__(self, config, train_data, objective=None):
         super().__init__(config, train_data, objective)

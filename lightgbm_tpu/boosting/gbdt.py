@@ -380,6 +380,11 @@ class GBDT:
     # per-iteration dispatch/sync cost of a remote chip by running T
     # iterations per dispatch (parallel/data_parallel.py train_many).
     # ------------------------------------------------------------------
+    # per-iteration host logic in a subclass (DART's drop/normalize,
+    # RF's refit averaging) cannot run inside the device scan; each
+    # boosting mode opts in explicitly
+    _supports_batched = True
+
     def can_train_batched(self) -> bool:
         """True when T iterations can run without host participation:
         single-model objective, no row sampling (bagging/GOSS draw host
@@ -387,7 +392,8 @@ class GBDT:
         (host-side percentiles / least squares per tree), and a learner
         whose scan needs no per-tree host state."""
         from .sample_strategy import SampleStrategy
-        return (self.objective is not None
+        return (self._supports_batched
+                and self.objective is not None
                 and not self.objective.is_renew_tree_output
                 and not getattr(self.objective,
                                 "has_stochastic_gradients", False)

@@ -20,6 +20,11 @@ from .gbdt import GBDT, kEpsilon
 
 
 class RF(GBDT):
+    # per-iteration refit averaging is host logic; this attribute is
+    # the load-bearing gate (an RF with only feature_fraction < 1 has
+    # the no-op sample strategy, so no other check would exclude it)
+    _supports_batched = False
+
     def __init__(self, config, train_data, objective=None):
         has_bag = (config.bagging_freq > 0
                    and 0.0 < config.bagging_fraction < 1.0) \

@@ -143,8 +143,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
                         "tpu_batch_iterations=%d ignored: the "
                         "configuration needs per-iteration host work "
                         "(sampling/monotone/CEGB/linear/renewal, a "
-                        "stochastic-gradient objective, or a "
-                        "multi-process learner)" % batch_n)
+                        "stochastic-gradient objective, DART/RF "
+                        "boosting, or a multi-process learner)"
+                        % batch_n)
                     degraded = True
             evaluation_result_list = []
             if valid_sets or eval_train_requested:

@@ -286,3 +286,22 @@ def test_engine_batch_best_score_without_early_stopping():
     # best_score even with no early stopping
     assert bst.best_iteration == 9
     assert "binary_logloss" in bst.best_score.get("valid_0", {})
+
+
+@pytest.mark.parametrize("boosting", ["dart", "rf"])
+def test_boosting_modes_not_batched(boosting):
+    """DART's drop/renormalize and RF's averaging are per-iteration
+    host logic — the fuzzer caught DART slipping through the gates
+    (its sample strategy is the no-op one) and corrupting its drop
+    state after a batch."""
+    rng = np.random.RandomState(33)
+    X = rng.randn(400, 6)
+    y = (X[:, 0] > 0).astype(float)
+    p = {"objective": "binary", "verbosity": -1, "num_leaves": 15,
+         "boosting": boosting, "tree_learner": "data",
+         "mesh_shape": "data=1", "tpu_batch_iterations": 3}
+    if boosting == "rf":
+        p.update({"bagging_fraction": 0.7, "bagging_freq": 1})
+    bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=6)
+    assert not bst.inner.can_train_batched()
+    assert len(bst.inner.models) == 6

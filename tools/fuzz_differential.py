@@ -73,6 +73,14 @@ def sample_case(rng):
         params["data_sample_strategy"] = "goss"
         params.pop("bagging_fraction", None)
         params.pop("bagging_freq", None)
+    if rng.rand() < 0.35:
+        # device-resident batched loop (engine falls back per-iteration
+        # when the sampled config is ineligible, so this composes with
+        # every other knob) — interchange must hold for batched-trained
+        # models too
+        params["tpu_batch_iterations"] = int(rng.choice([3, 5]))
+        params["tree_learner"] = "data"
+        params["mesh_shape"] = "data=1"
     n_cat = int(rng.choice([0, 0, 1, 2]))
     use_missing = rng.rand() < 0.3
     return params, n, f, n_cat, use_missing
@@ -165,6 +173,8 @@ def run_case(i, seed, ref_bin, workdir):
             "output_model=" + os.path.join(d, "ref_model.txt"),
             "num_trees=8"]
     for k, v in params.items():
+        if k.startswith("tpu_") or k == "mesh_shape":
+            continue  # TPU-runtime extensions; not reference params
         if isinstance(v, list):
             v = ",".join(str(x) for x in v)
         elif isinstance(v, bool):
