@@ -429,10 +429,13 @@ class Tree:
             t.cat_boundaries = [int(v)
                                 for v in kv["cat_boundaries"].split()]
             t.cat_threshold = [int(v) for v in kv["cat_threshold"].split()]
-            # categorical nodes store the cat-split index in `threshold`
+            # categorical nodes store the cat-split index in `threshold`;
+            # cast only those (numeric nodes may hold NaN thresholds,
+            # which trip a RuntimeWarning on int cast)
             cat_nodes = (t.decision_type[:ni] & kCategoricalMask) != 0
             t.threshold_in_bin[:ni] = np.where(
-                cat_nodes, t.threshold[:ni].astype(np.int32),
+                cat_nodes,
+                np.where(cat_nodes, t.threshold[:ni], 0).astype(np.int32),
                 t.threshold_in_bin[:ni])
         return t
 

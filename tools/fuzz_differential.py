@@ -216,6 +216,11 @@ def main():
               (tag, i, seed0 + i, msg, json.dumps(params)), flush=True)
         if not ok:
             failures.append((i, seed0 + i, msg, params))
+        if (i + 1) % 25 == 0:
+            # every case compiles fresh shapes; unbounded jit caches
+            # eventually OOM LLVM in long soaks (observed at ~120 cases)
+            import jax
+            jax.clear_caches()
     print("\n%d/%d passed" % (n_cases - len(failures), n_cases))
     sys.exit(1 if failures else 0)
 
