@@ -162,6 +162,17 @@ def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.io.dataset import BinnedDataset
     from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.obs import health as obs_health
+    from lightgbm_tpu.obs.registry import registry as obs_registry
+
+    # stage timing feeds the machine-readable ``phases`` dict of the
+    # result JSON (no TIMETAG env needed for the bench)
+    obs_registry.enable()
+    obs_health.record_backend(platform, source="bench")
+    if fallback:
+        # the probe's CPU fallback must be a Warning + structured event,
+        # not only a tail substring in the unit field (round-5 lesson)
+        obs_health.record_backend_fallback(fallback)
 
     _stage("gen_start", rows=n_rows, platform=platform)
     X, y = make_higgs_like(n_rows)
@@ -268,6 +279,12 @@ def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
                 % (platform, kernel, n_rows / 1e6, warmup, done, auc,
                    t_bin, t_warm, t_train, rows_note, fb_note),
         "vs_baseline": round(vs, 4),
+        # machine-readable health + phase attribution (obs subsystem):
+        # backend is a first-class key — a CPU fallback must never hide
+        # in the unit string again
+        "backend": platform,
+        "backend_fallback": fallback or None,
+        "phases": obs_registry.phases(),
     }
 
 
@@ -407,6 +424,7 @@ def main() -> None:
             "unit": "iters/s (FAILED: %s: %s)" % (type(e).__name__,
                                                   str(e)[:300]),
             "vs_baseline": 0.0,
+            "backend": None,
         }
         print(json.dumps(result))
         sys.exit(1)

@@ -18,6 +18,8 @@ from .boosting.gbdt import GBDT
 from .config import Config
 from .io.dataset import BinnedDataset
 from .metric import create_metric, resolve_metric_names
+from .obs import events as obs_events
+from .obs.registry import registry as obs
 from .utils import log
 
 _ArrayLike = Union[np.ndarray, Sequence]
@@ -320,6 +322,17 @@ class Booster:
 
     def _eval(self, valid_idx: Optional[int], name: str,
               feval=None) -> List[Tuple]:
+        with obs.scope("gbdt::eval_metrics"):
+            out = self._eval_inner(valid_idx, name, feval)
+        if out and obs_events.enabled():
+            obs_events.emit("eval", iter=self.inner.iter,
+                            results=[{"dataset": ds, "metric": mname,
+                                      "value": float(v)}
+                                     for ds, mname, v, _ in out])
+        return out
+
+    def _eval_inner(self, valid_idx: Optional[int], name: str,
+                    feval=None) -> List[Tuple]:
         inner = self.inner
         out = []
         if valid_idx is None:

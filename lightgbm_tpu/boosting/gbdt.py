@@ -15,6 +15,7 @@ src/treelearner/cuda/cuda_data_partition.cu).
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -22,6 +23,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import Config
+from ..obs import events as obs_events
+from ..obs import health as obs_health
+from ..obs.registry import registry as obs
 from ..io.binning import MissingType
 from ..io.dataset import BinnedDataset
 from ..metric import Metric, create_metric, resolve_metric_names
@@ -153,6 +157,9 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def _init_train(self, train_data: BinnedDataset) -> None:
+        # which platform actually executes is telemetry, not a tail
+        # string (obs/health.py; round-5 silent-CPU-fallback lesson)
+        obs_health.record_backend_once(source="gbdt_init")
         config = self.config
         if self.objective is None and config.objective not in (
                 "custom", "none"):
@@ -283,26 +290,30 @@ class GBDT:
         """One boosting iteration (reference: GBDT::TrainOneIter,
         gbdt.cpp:334). Returns True when training should stop (no
         splittable leaves anywhere)."""
+        t_iter0 = time.perf_counter()
         K = self.num_tree_per_iteration
         init_scores = [0.0] * K
-        if grad is None or hess is None:
-            if self.objective is None:
-                log.fatal("No objective function provided")
-            for k in range(K):
-                init_scores[k] = self._boost_from_average(k)
-            score = self.train_score[:, 0] if K == 1 else self.train_score
-            g, h = self.objective.get_gradients(score)
-        else:
-            g = jnp.asarray(np.asarray(grad, dtype=np.float32))
-            h = jnp.asarray(np.asarray(hess, dtype=np.float32))
-            if K > 1:
+        with obs.scope("gbdt::gradients"):
+            if grad is None or hess is None:
+                if self.objective is None:
+                    log.fatal("No objective function provided")
+                for k in range(K):
+                    init_scores[k] = self._boost_from_average(k)
+                score = self.train_score[:, 0] if K == 1 \
+                    else self.train_score
+                g, h = self.objective.get_gradients(score)
+            else:
+                g = jnp.asarray(np.asarray(grad, dtype=np.float32))
+                h = jnp.asarray(np.asarray(hess, dtype=np.float32))
+                if K > 1:
+                    g = g.reshape(K, self.num_data).T
+                    h = h.reshape(K, self.num_data).T
+            if K > 1 and g.ndim == 1:
                 g = g.reshape(K, self.num_data).T
                 h = h.reshape(K, self.num_data).T
-        if K > 1 and g.ndim == 1:
-            g = g.reshape(K, self.num_data).T
-            h = h.reshape(K, self.num_data).T
 
-        g, h, bag = self.sample_strategy.bagging(self.iter, g, h)
+        with obs.scope("gbdt::bagging"):
+            g, h, bag = self.sample_strategy.bagging(self.iter, g, h)
 
         should_continue = False
         new_trees = []
@@ -311,7 +322,8 @@ class GBDT:
             hk = h if K == 1 else h[:, k]
             tree: Optional[Tree] = None
             if self.class_need_train[k] and self.train_data.num_features > 0:
-                tree, leaf_of_row = self.learner.train(gk, hk, bag)
+                with obs.scope("tree::grow"):
+                    tree, leaf_of_row = self.learner.train(gk, hk, bag)
             if tree is not None and tree.num_leaves > 1:
                 should_continue = True
                 if self.config.linear_tree:
@@ -369,11 +381,30 @@ class GBDT:
             # keep the constant trees of the very first iteration
             self.models.extend(new_trees)
             self.iter += 1
+            self._emit_iter_event(new_trees, t_iter0)
             return True
 
         self.models.extend(new_trees)
         self.iter += 1
+        self._emit_iter_event(new_trees, t_iter0)
         return False
+
+    def _emit_iter_event(self, new_trees: List[Tree], t_start: float,
+                         batched: bool = False,
+                         seconds: Optional[float] = None) -> None:
+        """Per-iteration training event (iter index, wall time, tree
+        shape); eval results ride the separate ``eval`` event emitted by
+        eval_metrics (evaluation is metric_freq-gated)."""
+        if not obs_events.enabled():
+            return
+        if seconds is None:
+            seconds = time.perf_counter() - t_start
+        trees = [{"num_leaves": int(t.num_leaves),
+                  "depth": int(t.leaf_depth[:max(t.num_leaves, 1)].max())}
+                 for t in new_trees if t is not None]
+        obs_events.emit(
+            "train_iter", iter=self.iter, seconds=round(seconds, 6),
+            batched=batched, trees=trees)
 
     # ------------------------------------------------------------------
     # Device-resident batched iterations (mesh learners): amortize the
@@ -411,6 +442,7 @@ class GBDT:
         can_train_batched()."""
         from ..treelearner.serial import (apply_split_record,
                                           record_is_valid)
+        t_batch0 = time.perf_counter()
         learner = self.learner
         K = self.num_tree_per_iteration
         base = learner._tree_idx
@@ -423,12 +455,15 @@ class GBDT:
                        + 7919 * (base + 1 + t * K + k)) & 0x7FFFFFFF
                       for k in range(K)] for t in range(n_iters)]
             score0 = self.train_score
-        score_t, recs = learner.train_many(
-            self.objective.get_gradients, score0, seeds,
-            self.shrinkage_rate)
-        recs_h = jax.device_get(recs)
+        with obs.scope("tree::train_batch_dispatch"):
+            score_t, recs = learner.train_many(
+                self.objective.get_gradients, score0, seeds,
+                self.shrinkage_rate)
+            recs_h = jax.device_get(recs)
+        t_dispatch = time.perf_counter() - t_batch0
         kb = max(learner.L - 1, 1)
         stopped = False
+        applied = 0
         for t in range(n_iters):
             iter_trees = []
             grew_any = False
@@ -460,12 +495,26 @@ class GBDT:
                             "leaves that meet the split requirements")
                 stopped = True
                 break
-            for k, tree in enumerate(iter_trees):
-                self.models.append(tree)
-                if tree.num_leaves > 1:
-                    for vd in self.valid_data:
-                        vd.add_tree(tree, k, self._bin_meta)
+            with obs.scope("tree::apply_records"):
+                for k, tree in enumerate(iter_trees):
+                    self.models.append(tree)
+                    if tree.num_leaves > 1:
+                        for vd in self.valid_data:
+                            vd.add_tree(tree, k, self._bin_meta)
             self.iter += 1
+            applied += 1
+            # wall time amortized over the batch: the dispatch is one
+            # fused device program covering every iteration in it
+            self._emit_iter_event(iter_trees, 0.0, batched=True,
+                                  seconds=t_dispatch / n_iters)
+        if obs_events.enabled():
+            # ground-truth dispatch cost: the fused program ran all
+            # n_iters on device even when the host stopped applying
+            # early, so summing the amortized train_iter seconds
+            # under-counts on early stop — this event carries the total
+            obs_events.emit("train_batch", n_iters=n_iters,
+                            applied=applied, stopped=stopped,
+                            seconds=round(t_dispatch, 6))
         # score_t is correct even for a partial batch: a stump step (and
         # every step after it, which sees the same score and grows the
         # same stump) contributed zero output on device
@@ -480,6 +529,11 @@ class GBDT:
                       class_id: int) -> None:
         """Device gather of leaf outputs over the learner's final
         partition (reference: GBDT::UpdateScore, gbdt.cpp:475)."""
+        with obs.scope("gbdt::score_update"):
+            self._update_score_inner(tree, leaf_of_row, class_id)
+
+    def _update_score_inner(self, tree: Tree, leaf_of_row: jnp.ndarray,
+                            class_id: int) -> None:
         if tree.is_linear:
             # linear leaves need raw features → host prediction
             from ..models.linear import linear_predict
@@ -543,21 +597,29 @@ class GBDT:
         """Evaluate all metrics; returns (dataset_name, metric_name,
         value, is_bigger_better) tuples."""
         out = []
-        if self.train_metrics:
-            score = np.asarray(self.train_score, dtype=np.float64)
-            score = score[:, 0] if self.num_tree_per_iteration == 1 \
-                else score
-            for m in self.train_metrics:
-                for name, v in zip(m.name, m.eval(score, self.objective)):
-                    out.append(("training", name, v,
-                                m.factor_to_bigger_better > 0))
-        for i, vd in enumerate(self.valid_data):
-            score = vd.scores[:, 0] if self.num_tree_per_iteration == 1 \
-                else vd.scores
-            for m in vd.metrics:
-                for name, v in zip(m.name, m.eval(score, self.objective)):
-                    out.append(("valid_%d" % i, name, v,
-                                m.factor_to_bigger_better > 0))
+        with obs.scope("gbdt::eval_metrics"):
+            if self.train_metrics:
+                score = np.asarray(self.train_score, dtype=np.float64)
+                score = score[:, 0] if self.num_tree_per_iteration == 1 \
+                    else score
+                for m in self.train_metrics:
+                    for name, v in zip(m.name,
+                                       m.eval(score, self.objective)):
+                        out.append(("training", name, v,
+                                    m.factor_to_bigger_better > 0))
+            for i, vd in enumerate(self.valid_data):
+                score = vd.scores[:, 0] \
+                    if self.num_tree_per_iteration == 1 else vd.scores
+                for m in vd.metrics:
+                    for name, v in zip(m.name,
+                                       m.eval(score, self.objective)):
+                        out.append(("valid_%d" % i, name, v,
+                                    m.factor_to_bigger_better > 0))
+        if out and obs_events.enabled():
+            obs_events.emit("eval", iter=self.iter,
+                            results=[{"dataset": ds, "metric": name,
+                                      "value": float(v)}
+                                     for ds, name, v, _ in out])
         return out
 
     def _check_early_stopping(self, eval_list) -> bool:

@@ -63,6 +63,8 @@ class RF(GBDT):
     def train_one_iter(self, grad=None, hess=None) -> bool:
         assert grad is None and hess is None, \
             "RF does not take external gradients"
+        import time
+        t_iter0 = time.perf_counter()
         K = self.num_tree_per_iteration
         g, h, bag = self.sample_strategy.bagging(
             self.iter, self._grad, self._hess)
@@ -101,4 +103,5 @@ class RF(GBDT):
                     tree = Tree(1)
             self.models.append(tree)
         self.iter += 1
+        self._emit_iter_event(self.models[-K:], t_iter0)
         return False

@@ -21,6 +21,8 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from ..config import Config
+from ..obs import events as obs_events
+from ..obs.registry import registry as obs
 from ..utils import log
 from .binning import BinMapper, BinType, MissingType
 
@@ -212,52 +214,53 @@ class BinnedDataset:
             mappers: List[BinMapper] = []
             sample_bin_cols: List[np.ndarray] = []
             sample_cnt_eff = sample_cnt if sample_idx is not None else n
-            for f in range(num_total_features):
-                bm = BinMapper()
-                max_bin_f = (max_bin_by_feature[f]
-                             if f < len(max_bin_by_feature) else config.max_bin)
-                if is_sparse:
-                    # feed the binner only the sampled NON-ZERO values;
-                    # total_sample_cnt accounts the zeros (the reference
-                    # samples exactly this way —
-                    # DatasetLoader::SampleTextData keeps non-zeros +
-                    # the global sample count, dataset_loader.cpp:593)
-                    rows, vals = col_nonzero(f)
-                    if sample_idx is not None:
-                        pos = np.searchsorted(sample_idx, rows)
-                        pos_ok = pos < len(sample_idx)
-                        pos_ok[pos_ok] &= (sample_idx[pos[pos_ok]]
-                                           == rows[pos_ok])
-                        sample_col = vals[pos_ok]
-                        sample_rows = pos[pos_ok]
-                    else:
-                        sample_col = vals
-                        sample_rows = rows
-                else:
-                    col = full_col(f)
-                    sample_col = (col if sample_idx is None
-                                  else col[sample_idx])
-                bm.find_bin(
-                    sample_col, total_sample_cnt=sample_cnt_eff,
-                    max_bin=max_bin_f,
-                    min_data_in_bin=config.min_data_in_bin,
-                    min_split_data=config.min_data_in_leaf,
-                    pre_filter=config.feature_pre_filter,
-                    bin_type=(BinType.CATEGORICAL if f in cat_set
-                              else BinType.NUMERICAL),
-                    use_missing=config.use_missing,
-                    zero_as_missing=config.zero_as_missing,
-                    forced_upper_bounds=forced_bounds.get(f))
-                mappers.append(bm)
-                if not bm.is_trivial:
+            with obs.scope("io::find_bins"):
+                for f in range(num_total_features):
+                    bm = BinMapper()
+                    max_bin_f = (max_bin_by_feature[f]
+                                 if f < len(max_bin_by_feature) else config.max_bin)
                     if is_sparse:
-                        sb = np.full(sample_cnt_eff, bm.default_bin,
-                                     dtype=np.int32)
-                        sb[sample_rows] = bm.value_to_bin(sample_col)
-                        sample_bin_cols.append(sb)
+                        # feed the binner only the sampled NON-ZERO values;
+                        # total_sample_cnt accounts the zeros (the reference
+                        # samples exactly this way —
+                        # DatasetLoader::SampleTextData keeps non-zeros +
+                        # the global sample count, dataset_loader.cpp:593)
+                        rows, vals = col_nonzero(f)
+                        if sample_idx is not None:
+                            pos = np.searchsorted(sample_idx, rows)
+                            pos_ok = pos < len(sample_idx)
+                            pos_ok[pos_ok] &= (sample_idx[pos[pos_ok]]
+                                               == rows[pos_ok])
+                            sample_col = vals[pos_ok]
+                            sample_rows = pos[pos_ok]
+                        else:
+                            sample_col = vals
+                            sample_rows = rows
                     else:
-                        sample_bin_cols.append(
-                            bm.value_to_bin(sample_col).astype(np.int32))
+                        col = full_col(f)
+                        sample_col = (col if sample_idx is None
+                                      else col[sample_idx])
+                    bm.find_bin(
+                        sample_col, total_sample_cnt=sample_cnt_eff,
+                        max_bin=max_bin_f,
+                        min_data_in_bin=config.min_data_in_bin,
+                        min_split_data=config.min_data_in_leaf,
+                        pre_filter=config.feature_pre_filter,
+                        bin_type=(BinType.CATEGORICAL if f in cat_set
+                                  else BinType.NUMERICAL),
+                        use_missing=config.use_missing,
+                        zero_as_missing=config.zero_as_missing,
+                        forced_upper_bounds=forced_bounds.get(f))
+                    mappers.append(bm)
+                    if not bm.is_trivial:
+                        if is_sparse:
+                            sb = np.full(sample_cnt_eff, bm.default_bin,
+                                         dtype=np.int32)
+                            sb[sample_rows] = bm.value_to_bin(sample_col)
+                            sample_bin_cols.append(sb)
+                        else:
+                            sample_bin_cols.append(
+                                bm.value_to_bin(sample_col).astype(np.int32))
             self.bin_mappers = [m for m in mappers if not m.is_trivial]
             self.used_feature_map = [i for i, m in enumerate(mappers)
                                      if not m.is_trivial]
@@ -272,7 +275,8 @@ class BinnedDataset:
                 self.num_bin_per_feature) else 1
             self._set_constraints(config)
             if config.enable_bundle and len(self.bin_mappers) > 1:
-                self._find_bundles(sample_bin_cols, config)
+                with obs.scope("io::efb_bundle"):
+                    self._find_bundles(sample_bin_cols, config)
 
         # --- full binning pass (O(nnz) per column on sparse input) ---
         def binned_col(j: int) -> np.ndarray:
@@ -284,20 +288,22 @@ class BinnedDataset:
                 return out
             return bm.value_to_bin(full_col(f))
 
-        if self.bundle is not None:
-            from .efb import bundle_columns
-            dtype = (np.uint8 if self.bundle.num_bundled_bins <= 256
-                     else np.uint16)
-            zero_bins = np.asarray([m.default_bin for m in self.bin_mappers],
-                                   dtype=np.int32)
-            self.bins = bundle_columns(binned_col, self.bundle,
-                                       zero_bins, n, dtype)
-        else:
-            dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
-            bins = np.empty((n, len(self.bin_mappers)), dtype=dtype)
-            for j in range(len(self.bin_mappers)):
-                bins[:, j] = binned_col(j).astype(dtype)
-            self.bins = bins
+        with obs.scope("io::apply_bins"):
+            if self.bundle is not None:
+                from .efb import bundle_columns
+                dtype = (np.uint8 if self.bundle.num_bundled_bins <= 256
+                         else np.uint16)
+                zero_bins = np.asarray(
+                    [m.default_bin for m in self.bin_mappers],
+                    dtype=np.int32)
+                self.bins = bundle_columns(binned_col, self.bundle,
+                                           zero_bins, n, dtype)
+            else:
+                dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
+                bins = np.empty((n, len(self.bin_mappers)), dtype=dtype)
+                for j in range(len(self.bin_mappers)):
+                    bins[:, j] = binned_col(j).astype(dtype)
+                self.bins = bins
         if keep_raw_data:
             self.raw_data = data
 
@@ -307,6 +313,12 @@ class BinnedDataset:
         self.metadata.set_weights(weights)
         self.metadata.set_group(group)
         self.metadata.set_init_score(init_score)
+        obs_events.emit(
+            "dataset", num_data=n, num_features=self.num_features,
+            num_total_features=num_total_features,
+            max_num_bin=self.max_num_bin,
+            bundled=self.bundle is not None,
+            aligned_to_reference=reference is not None)
         return self
 
     # ------------------------------------------------------------------

@@ -1,0 +1,36 @@
+"""Structured telemetry for the whole training pipeline.
+
+The reference builds per-stage observability directly into the trainer
+(``Common::Timer``/``FunctionTimer`` RAII scopes around every pipeline
+stage, include/LightGBM/utils/common.h:973,1037, aggregated table printed
+at exit under -DUSE_TIMETAG). This package is the TPU-native superset:
+
+- :mod:`registry`  — counters, gauges, and the stage timer (absorbs the
+  old ``utils/timer.py``; scopes still open
+  ``jax.profiler.TraceAnnotation`` ranges so stages are attributable in
+  TensorBoard/perfetto device traces).
+- :mod:`events`    — a JSON-lines event sink (``LIGHTGBM_TPU_EVENT_LOG``
+  env var or a programmatic callback mirroring
+  ``log.register_log_callback``).
+- :mod:`compile`   — XLA compile/retrace tracking per jitted function.
+- :mod:`health`    — backend selection / fallback events.
+
+Enable stage timing with ``LIGHTGBM_TPU_TIMETAG=1`` (the analogue of
+-DUSE_TIMETAG) or ``registry.enable()``; route events to a file with
+``LIGHTGBM_TPU_EVENT_LOG=path`` or ``events.register_event_callback``.
+See docs/OBSERVABILITY.md for the event schema.
+"""
+from __future__ import annotations
+
+from . import compile as compile_tracking  # noqa: F401
+from . import events, health  # noqa: F401
+from .registry import MetricsRegistry, StageTimer, registry  # noqa: F401
+
+scope = registry.scope
+counter = registry.inc
+gauge = registry.gauge
+
+__all__ = [
+    "MetricsRegistry", "StageTimer", "registry", "events", "health",
+    "compile_tracking", "scope", "counter", "gauge",
+]

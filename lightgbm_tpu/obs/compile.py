@@ -1,0 +1,87 @@
+"""XLA compile / retrace tracking.
+
+``jax.jit`` re-runs the wrapped Python body once per new static
+signature — every execution of the body IS a trace (and, absent a
+compilation-cache hit, a compile). Wrapping the body with
+:func:`traced` therefore counts compilations per function without
+reaching into jax internals, and surfaces unexpected retraces: a
+function that keeps re-tracing is burning compile time the device
+trace will never show. The recorded seconds cover the Python trace
+only — XLA lowering + backend compilation happen after the body
+returns, so ``trace_seconds`` is a lower bound / proxy, not the full
+compile cost (which on a remote TPU can be 100x the trace).
+
+The per-name counters live in the metrics registry under
+``jit_trace/<name>``; each trace also emits a ``jit_trace`` event.
+The learners legitimately compile several shape variants (the serial
+learner's ~log2(N) gather buckets), so the retrace warning fires only
+past ``LIGHTGBM_TPU_RETRACE_WARN`` traces of one name (default 32;
+0 disables).
+"""
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Callable
+
+from ..utils import log
+from . import events
+from .registry import registry
+
+_WARNED = set()
+
+
+def _warn_threshold() -> int:
+    try:
+        return int(os.environ.get("LIGHTGBM_TPU_RETRACE_WARN", "32"))
+    except ValueError:
+        return 32
+
+
+def record_trace(name: str, seconds: float = 0.0) -> int:
+    """Count one trace/compile of ``name``; returns the cumulative
+    count. ``seconds`` is the Python-trace wall time (a lower bound on
+    the compile cost — see module docstring); it aggregates under the
+    ``jit::<name>`` stage regardless of the TIMETAG gate so the retrace
+    evidence survives into BENCH phases."""
+    n = registry.inc("jit_trace/" + name)
+    registry.timer.totals["jit::" + name] += seconds
+    registry.timer.counts["jit::" + name] += 1
+    events.emit("jit_trace", fn=name, count=n,
+                trace_seconds=round(seconds, 6))
+    thr = _warn_threshold()
+    if thr and n == thr + 1 and name not in _WARNED:
+        _WARNED.add(name)
+        log.warning("jit function %r traced %d times — unexpected "
+                    "retraces? (threshold LIGHTGBM_TPU_RETRACE_WARN=%d)"
+                    % (name, n, thr))
+    return n
+
+
+def traced(name: str) -> Callable:
+    """Decorator for a function about to be ``jax.jit``-ed: the wrapper
+    records a trace each time the Python body runs (i.e. each
+    compilation), timing the trace itself. Positional-argument
+    passthrough keeps ``donate_argnums``/``static_argnums`` indices
+    valid."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                record_trace(name, time.perf_counter() - t0)
+        return wrapper
+    return deco
+
+
+def trace_count(name: str) -> int:
+    return registry.count("jit_trace/" + name)
+
+
+def trace_counts() -> dict:
+    prefix = "jit_trace/"
+    return {k[len(prefix):]: v for k, v in registry.counters.items()
+            if k.startswith(prefix)}

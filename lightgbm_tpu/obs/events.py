@@ -1,0 +1,112 @@
+"""Structured JSON-lines event sink.
+
+Every event is one JSON object per line::
+
+    {"ts": 1722700000.123, "event": "train_iter", "iter": 4, ...}
+
+Two sinks, both optional and independent:
+
+- a file, named by ``LIGHTGBM_TPU_EVENT_LOG=path`` (read per emit, so a
+  late ``os.environ`` assignment still takes effect) or pinned
+  programmatically with :func:`configure`;
+- a Python callback registered via :func:`register_event_callback` —
+  the event-stream mirror of ``log.register_log_callback``
+  (reference: LGBM_RegisterLogCallback, src/c_api.cpp:904).
+
+Emission with no sink configured is a few dict lookups — cheap enough
+to leave the call sites unconditional.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+_ENV_VAR = "LIGHTGBM_TPU_EVENT_LOG"
+
+_callback: Optional[Callable[[Dict], None]] = None
+_path_override: Optional[str] = None
+_lock = threading.Lock()
+
+
+def configure(path: Optional[str]) -> None:
+    """Pin the event-log path programmatically (overrides the env var;
+    pass None to fall back to ``LIGHTGBM_TPU_EVENT_LOG``)."""
+    global _path_override
+    _path_override = path
+
+
+def register_event_callback(fn: Optional[Callable[[Dict], None]]) -> None:
+    """Route every event dict through ``fn`` (None unregisters)."""
+    global _callback
+    _callback = fn
+
+
+def sink_path() -> Optional[str]:
+    return _path_override or os.environ.get(_ENV_VAR) or None
+
+
+def enabled() -> bool:
+    return _callback is not None or sink_path() is not None
+
+
+def _jsonable(v):
+    """Coerce numpy scalars / odd payloads into JSON-native types."""
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    try:
+        import numpy as np
+        if isinstance(v, np.integer):
+            return int(v)
+        if isinstance(v, np.floating):
+            return float(v)
+        if isinstance(v, np.ndarray):
+            return v.tolist()
+    except Exception:
+        pass
+    return str(v)
+
+
+def emit(event: str, **fields) -> Optional[Dict]:
+    """Emit one structured event to every configured sink. Returns the
+    event dict (or None when no sink is active). Never raises: telemetry
+    must not take training down."""
+    if not enabled():
+        return None
+    rec = {"ts": round(time.time(), 6), "event": event}
+    for k, v in fields.items():
+        rec[k] = _jsonable(v)
+    cb = _callback
+    if cb is not None:
+        try:
+            cb(rec)
+        except Exception:
+            pass
+    path = sink_path()
+    if path is not None:
+        try:
+            line = json.dumps(rec)
+            with _lock:
+                with open(path, "a") as f:
+                    f.write(line + "\n")
+        except Exception:
+            pass
+    return rec
+
+
+def read_jsonl(path: str):
+    """Parse an event-log file back into a list of event dicts (raises
+    on malformed lines — the test-side round-trip check)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -1,0 +1,65 @@
+"""Backend health events: selection, fallback, degradation.
+
+Round-5 evidence (BENCH_r05.json) motivated this module: a silent CPU
+fallback — "tpu backend probe failed/timed out (3 attempts)" — whose
+only trace was a substring in a free-text unit field. Backend state is
+now a first-class, machine-readable event:
+
+- ``backend``          — which platform is actually executing, emitted
+  once per process at first training.
+- ``backend_fallback`` — a requested accelerator degraded to another
+  platform, with the reason; always mirrored as a Warning log line.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils import log
+from . import events
+from .registry import registry
+
+_reported = False
+
+
+def record_backend(platform: Optional[str] = None,
+                   source: str = "") -> Optional[str]:
+    """Emit the ``backend`` event (platform + device count). With no
+    explicit ``platform``, asks jax — safe only once a backend exists.
+    Also sets the ``backend`` gauge consumed by bench."""
+    n_devices = None
+    try:
+        import jax
+        if platform is None:
+            platform = jax.default_backend()
+        n_devices = len(jax.devices())
+    except Exception:
+        if platform is None:
+            return None
+    global _reported
+    _reported = True  # an explicit record IS the process's record
+    registry.gauge("backend", platform)
+    events.emit("backend", platform=platform, num_devices=n_devices,
+                source=source)
+    return platform
+
+
+def record_backend_once(source: str = "") -> None:
+    """Process-wide once-only backend record (first training emits)."""
+    global _reported
+    if _reported:
+        return
+    _reported = True
+    record_backend(source=source)
+
+
+def record_backend_fallback(reason: str, requested: str = "tpu",
+                            actual: str = "cpu") -> None:
+    """An accelerator request degraded: Warning log (the reference's
+    Log::Warning discipline — degradation is never silent, so the
+    verbosity gate is bypassed) + a structured ``backend_fallback``
+    event + a counter."""
+    log.warning_always("backend fallback: requested %s, running on %s "
+                       "(%s)" % (requested, actual, reason))
+    registry.inc("backend_fallback")
+    events.emit("backend_fallback", requested=requested, actual=actual,
+                reason=reason)

@@ -1,0 +1,203 @@
+"""Metrics registry: counters, gauges, stage timers.
+
+Absorbs the old ``utils/timer.py`` ``Timer`` (reference:
+``Common::Timer``/``FunctionTimer``, include/LightGBM/utils/common.h:973,
+1037 — RAII scopes around every pipeline stage, aggregated table printed
+at exit when built with USE_TIMETAG). The TPU twist: enabled scopes also
+open ``jax.profiler.TraceAnnotation`` ranges so the same stage names show
+up in TensorBoard/perfetto device traces.
+
+``jax.profiler`` is resolved ONCE at first use and the failure cached —
+per-leaf scopes in the hot tree-growth loop must not pay Python
+import-machinery overhead on every entry.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from ..utils import log
+
+# jax.profiler, resolved once: None = unresolved, False = unavailable
+_profiler_mod = None
+
+
+def _get_profiler():
+    global _profiler_mod
+    if _profiler_mod is None:
+        try:
+            import jax.profiler as _p
+            _profiler_mod = _p
+        except Exception:
+            _profiler_mod = False
+    return _profiler_mod if _profiler_mod is not False else None
+
+
+class StageTimer:
+    """Per-stage wall-time aggregation (reference: FunctionTimer,
+    common.h:1037). Enable with ``LIGHTGBM_TPU_TIMETAG=1`` or
+    ``enable()``."""
+
+    def __init__(self) -> None:
+        self.enabled = bool(int(os.environ.get("LIGHTGBM_TPU_TIMETAG",
+                                               "0")))
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    @contextmanager
+    def scope(self, name: str):
+        """RAII stage scope (reference: FunctionTimer, common.h:1037)."""
+        if not self.enabled:
+            yield
+            return
+        annotation = None
+        profiler = _get_profiler()
+        if profiler is not None:
+            try:
+                annotation = profiler.TraceAnnotation(name)
+                annotation.__enter__()
+            except Exception:
+                annotation = None
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - start
+            self.counts[name] += 1
+            if annotation is not None:
+                annotation.__exit__(None, None, None)
+
+    def print_summary(self) -> None:
+        """reference: Timer::Print (common.h:1006) — per-stage totals.
+        Prints regardless of verbosity: timing was explicitly enabled,
+        exactly like a -DUSE_TIMETAG build's exit dump."""
+        if not self.totals:
+            return
+        width = max(len(k) for k in self.totals)
+        log.always("%s" % ("-" * (width + 30)))
+        log.always("%-*s %12s %8s" % (width, "stage", "seconds", "calls"))
+        for name in sorted(self.totals, key=lambda k: -self.totals[k]):
+            log.always("%-*s %12.6f %8d"
+                       % (width, name, self.totals[name],
+                          self.counts[name]))
+
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counts.clear()
+
+
+class MetricsRegistry:
+    """Counters + gauges + the stage timer, one process-wide instance.
+
+    Counters and gauges are always live (they back compile/health
+    tracking and cost single dict writes); stage timing is gated on the
+    timer's ``enabled`` flag like the reference's USE_TIMETAG build."""
+
+    def __init__(self) -> None:
+        self.timer = StageTimer()
+        self.counters: Dict[str, int] = defaultdict(int)
+        self.gauges: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        # Profiling mode: fence (block_until_ready) at stage boundaries
+        # so async dispatch can't smear one stage into the next. On only
+        # under an explicit LIGHTGBM_TPU_TIMETAG ask — programmatic
+        # enable() (the bench) keeps aggregate timing WITHOUT fences,
+        # since fencing perturbs the very throughput being measured.
+        self.fences = self.timer.enabled
+
+    # -- stage timers ---------------------------------------------------
+    def scope(self, name: str):
+        return self.timer.scope(name)
+
+    def enable(self) -> None:
+        self.timer.enable()
+
+    def disable(self) -> None:
+        self.timer.disable()
+
+    @property
+    def enabled(self) -> bool:
+        return self.timer.enabled
+
+    def fence(self) -> bool:
+        """True when stage boundaries should block_until_ready."""
+        return self.timer.enabled and self.fences
+
+    # -- counters / gauges ---------------------------------------------
+    def inc(self, name: str, n: int = 1) -> int:
+        with self._lock:
+            self.counters[name] += n
+            return self.counters[name]
+
+    def count(self, name: str) -> int:
+        return self.counters.get(name, 0)
+
+    def gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = value
+
+    # -- aggregation ----------------------------------------------------
+    def phases(self) -> Dict[str, Dict[str, float]]:
+        """Machine-readable stage table: {stage: {seconds, calls}} —
+        what BENCH JSON publishes as its ``phases`` dict."""
+        return {name: {"seconds": round(self.timer.totals[name], 6),
+                       "calls": self.timer.counts[name]}
+                for name in self.timer.totals}
+
+    def snapshot(self) -> Dict:
+        return {"phases": self.phases(),
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges)}
+
+    def print_summary(self) -> None:
+        self.timer.print_summary()
+
+    def reset(self) -> None:
+        self.timer.reset()
+        with self._lock:
+            self.counters.clear()
+        self.gauges.clear()
+
+
+registry = MetricsRegistry()
+
+
+def scoped(name: str):
+    """Decorator form of ``registry.scope`` — the FunctionTimer analogue
+    for whole functions."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with registry.scope(name):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+@atexit.register
+def _print_at_exit() -> None:
+    if registry.timer.enabled:
+        registry.timer.print_summary()
+
+
+def start_device_trace(logdir: str) -> None:
+    """Start a jax profiler trace (device timeline → TensorBoard)."""
+    import jax.profiler
+    jax.profiler.start_trace(logdir)
+
+
+def stop_device_trace() -> None:
+    import jax.profiler
+    jax.profiler.stop_trace()

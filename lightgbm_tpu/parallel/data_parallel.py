@@ -52,6 +52,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..io.dataset import BinnedDataset
 from ..models.tree import Tree
+from ..obs import compile as obs_compile
+from ..obs.registry import registry as obs
 from ..ops.histogram import (build_histogram, subtract_histogram,
                              unpack_bundle_histogram)
 from ..ops.split import (FeatureMeta, SplitParams, calculate_leaf_output,
@@ -118,8 +120,9 @@ class DataParallelTreeLearner(CapabilityMixin):
                 shard[:avail] = cols_host[start:start + avail]
             return shard
 
-        self.bins = jax.make_array_from_callback(
-            (self.R, C), sharding, _shard)
+        with obs.scope("io::stage_bins_device"):
+            self.bins = jax.make_array_from_callback(
+                (self.R, C), sharding, _shard)
         self._init_cegb(config)
         self._init_monotone(config)
 
@@ -247,10 +250,20 @@ class DataParallelTreeLearner(CapabilityMixin):
         if not self._bundled:
             h = build_histogram(bins, gh, self.B, pallas_ok=p_ok,
                                 hist_impl=self._hist_impl)
-            return jax.lax.with_sharding_constraint(h, self.hist_sharding)
+            # named so the XLA-inserted cross-device reduce is
+            # attributable in device traces; the feature-parallel
+            # subclass keeps histograms sharded (no psum crosses here),
+            # so its boundary gets a distinct name
+            name = ("obs_psum_histogram"
+                    if self.hist_sharding == self.rep_sharding
+                    else "obs_hist_feature_sharded")
+            with jax.named_scope(name):
+                return jax.lax.with_sharding_constraint(
+                    h, self.hist_sharding)
         bh = build_histogram(bins, gh, self.Bg, pallas_ok=p_ok,
                              hist_impl=self._hist_impl)
-        bh = jax.lax.with_sharding_constraint(bh, self.rep_sharding)
+        with jax.named_scope("obs_psum_bundle_histogram"):
+            bh = jax.lax.with_sharding_constraint(bh, self.rep_sharding)
         return unpack_bundle_histogram(bh, self._btab.gidx_g,
                                        self._btab.gidx_b,
                                        self._btab.zero_fix,
@@ -571,8 +584,10 @@ class DataParallelTreeLearner(CapabilityMixin):
     def _adv_scan(self, state, leaf, sums, bound_arrays, depth, allowed,
                   feature_mask):
         if self._adv_rescan_fn is None:
-            self._adv_rescan_fn = jax.jit(self._adv_rescan_impl,
-                                          donate_argnums=(0,))
+            self._adv_rescan_fn = jax.jit(
+                obs_compile.traced("mesh.adv_rescan")(
+                    self._adv_rescan_impl),
+                donate_argnums=(0,))
         sg, sh, c, tc = sums
         min_c, max_c = bound_arrays
         return self._adv_rescan_fn(
@@ -584,9 +599,11 @@ class DataParallelTreeLearner(CapabilityMixin):
     # --- adapter methods for the shared capability drivers ------------
     def _cegb_root(self, gh, feature_mask):
         if self._cegb_root_fn is None:
-            self._cegb_root_fn = jax.jit(self._cegb_root_impl)
-            self._cegb_step_fn = jax.jit(self._cegb_step_impl,
-                                         donate_argnums=(1,))
+            self._cegb_root_fn = jax.jit(
+                obs_compile.traced("mesh.cegb_root")(self._cegb_root_impl))
+            self._cegb_step_fn = jax.jit(
+                obs_compile.traced("mesh.cegb_step")(self._cegb_step_impl),
+                donate_argnums=(1,))
         return self._cegb_root_fn(self.bins, gh, feature_mask,
                                   self._cegb_used, self._cegb_fetched)
 
@@ -611,10 +628,12 @@ class DataParallelTreeLearner(CapabilityMixin):
     def _mono_step(self, state, leaf, k, allowed, feature_mask, bounds,
                    smaller):
         if self._mono_step_fn is None:
-            self._mono_step_fn = jax.jit(self._mono_step_impl,
-                                         donate_argnums=(1,))
-            self._rescan_fn = jax.jit(self._rescan_impl,
-                                      donate_argnums=(0,))
+            self._mono_step_fn = jax.jit(
+                obs_compile.traced("mesh.mono_step")(self._mono_step_impl),
+                donate_argnums=(1,))
+            self._rescan_fn = jax.jit(
+                obs_compile.traced("mesh.rescan")(self._rescan_impl),
+                donate_argnums=(0,))
         return self._mono_step_fn(
             self.bins, state, jnp.int32(leaf), jnp.int32(k), feature_mask,
             jnp.float32(bounds[0]), jnp.float32(bounds[1]),
@@ -632,7 +651,9 @@ class DataParallelTreeLearner(CapabilityMixin):
     def _node_step(self, state, leaf, k, allowed, mask_left, mask_right,
                    rand_seed, smaller):
         if self._step_fn is None:
-            self._step_fn = jax.jit(self._step_impl, donate_argnums=(1,))
+            self._step_fn = jax.jit(
+                obs_compile.traced("mesh.step")(self._step_impl),
+                donate_argnums=(1,))
         return self._step_fn(self.bins, state, jnp.int32(leaf),
                              jnp.int32(k), mask_left, mask_right,
                              jnp.int32(rand_seed))
@@ -640,8 +661,11 @@ class DataParallelTreeLearner(CapabilityMixin):
     # ------------------------------------------------------------------
     def _ensure_compiled(self):
         if self._root_fn is None:
-            self._root_fn = jax.jit(self._root_impl)
-            self._tree_fn = jax.jit(self._tree_impl, donate_argnums=(1,))
+            self._root_fn = jax.jit(
+                obs_compile.traced("mesh.root")(self._root_impl))
+            self._tree_fn = jax.jit(
+                obs_compile.traced("mesh.tree")(self._tree_impl),
+                donate_argnums=(1,))
 
     def _splittable(self, depth: int) -> bool:
         return self.max_depth <= 0 or depth < self.max_depth
@@ -667,8 +691,11 @@ class DataParallelTreeLearner(CapabilityMixin):
         path there is exactly one host read-back per tree: the [L-1]
         record buffer."""
         self._ensure_compiled()
-        gh = self._make_gh(grad, hess, bag)
-        feature_mask = self._sample_features()
+        with obs.scope("tree::stage_gh"):
+            gh = self._make_gh(grad, hess, bag)
+            if obs.fence():
+                jax.block_until_ready(gh)
+            feature_mask = self._sample_features()
 
         tree = Tree(self.L)
         self._tree_idx += 1
@@ -681,19 +708,28 @@ class DataParallelTreeLearner(CapabilityMixin):
             state = train_monotone(self, tree, gh, feature_mask,
                                    rand_seed)
             return tree, self._finalize_partition(state.leaf_of_row)
-        state, rec = self._root_fn(self.bins, gh, feature_mask, rand_seed)
+        with obs.scope("tree::root_histogram"):
+            state, rec = self._root_fn(self.bins, gh, feature_mask,
+                                       rand_seed)
+            if obs.fence():
+                jax.block_until_ready(rec)
         if self._needs_per_node_masks():
             state = train_stepwise(self, tree, state, rec, feature_mask,
                                    rand_seed)
             return tree, self._finalize_partition(state.leaf_of_row)
-        state, recs = self._tree_fn(self.bins, state, feature_mask,
-                                    rand_seed)
-        recs_h = jax.device_get(recs)
-        for i in range(self.L - 1):
-            r = jax.tree_util.tree_map(lambda a: a[i], recs_h)
-            if not record_is_valid(r):
-                break
-            apply_split_record(tree, self.dataset, r)
+        # whole-tree dispatch (child histograms + split scans fused);
+        # the device_get is the per-tree sync, so the scope covers the
+        # real device time
+        with obs.scope("tree::split_batches"):
+            state, recs = self._tree_fn(self.bins, state, feature_mask,
+                                        rand_seed)
+            recs_h = jax.device_get(recs)
+        with obs.scope("tree::apply_records"):
+            for i in range(self.L - 1):
+                r = jax.tree_util.tree_map(lambda a: a[i], recs_h)
+                if not record_is_valid(r):
+                    break
+                apply_split_record(tree, self.dataset, r)
         return tree, self._finalize_partition(state.leaf_of_row)
 
     # --- device-resident multi-iteration batching ---------------------
@@ -813,8 +849,11 @@ class DataParallelTreeLearner(CapabilityMixin):
         # would re-jit the scan
         if self._many_fn is None or self._many_grad_fn != grad_fn:
             self._many_grad_fn = grad_fn
-            self._many_fn = jax.jit(self._many_impl)
-            self._many_multi_fn = jax.jit(self._many_impl_multi)
+            self._many_fn = jax.jit(
+                obs_compile.traced("mesh.train_many")(self._many_impl))
+            self._many_multi_fn = jax.jit(
+                obs_compile.traced("mesh.train_many_multi")(
+                    self._many_impl_multi))
         feature_mask = self._sample_features()
         self._tree_idx += int(seeds.size)
         fn = self._many_multi_fn if seeds.ndim == 2 else self._many_fn

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
 from ..io.dataset import BinnedDataset
+from ..obs.registry import registry as obs
 from ..utils import log
 from .data_parallel import DataParallelTreeLearner
 
@@ -74,9 +75,11 @@ def distributed_binned_dataset(local_X: np.ndarray, config: Config,
     # pad to a common per-process shape for the allgather; padding rows
     # are trimmed back out via the gathered count vector (a zeros row
     # covers the empty-shard case)
-    counts = multihost_utils.process_allgather(
-        np.asarray([take], dtype=np.int64))
-    max_take = int(np.asarray(counts).max())
+    # process_allgather adds NO leading process axis when n_proc == 1;
+    # reshape(n_proc, ...) normalizes both layouts
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.asarray([take], dtype=np.int64))).reshape(n_proc, -1)[:, 0]
+    max_take = int(counts.max())
     if take < max_take:
         pad_row = sample[:1] if take > 0 else np.zeros(
             (1, local_X.shape[1]), dtype=local_X.dtype)
@@ -88,9 +91,9 @@ def distributed_binned_dataset(local_X: np.ndarray, config: Config,
     # round-trip exactly
     bits = np.ascontiguousarray(sample).view(np.int32)
     gathered_bits = np.asarray(multihost_utils.process_allgather(bits))
-    gathered = np.ascontiguousarray(gathered_bits).view(np.float64)
-    parts = [gathered[p][:int(np.asarray(counts)[p, 0])]
-             for p in range(n_proc)]
+    gathered = np.ascontiguousarray(gathered_bits).view(np.float64) \
+        .reshape(n_proc, max_take, local_X.shape[1])
+    parts = [gathered[p][:int(counts[p])] for p in range(n_proc)]
     full_sample = np.concatenate(parts, axis=0)
 
     # every process now builds mappers from the identical global sample,
@@ -142,8 +145,9 @@ class DistributedDataParallelLearner(DataParallelTreeLearner):
 
         local_bins = np.zeros((block, C), dtype=bins_local.dtype)
         local_bins[:n_local] = bins_local
-        self.bins = jax.make_array_from_process_local_data(
-            NamedSharding(mesh, P(self.axis, None)), local_bins)
+        with obs.scope("io::stage_bins_device"):
+            self.bins = jax.make_array_from_process_local_data(
+                NamedSharding(mesh, P(self.axis, None)), local_bins)
         self._init_cegb(config)
         self._init_monotone(config)
 

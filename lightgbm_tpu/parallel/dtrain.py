@@ -31,6 +31,9 @@ from ..basic import Booster
 from ..config import Config
 from ..metric import create_metric
 from ..objective import create_objective
+from ..obs import events as obs_events
+from ..obs import health as obs_health
+from ..obs.registry import registry as obs
 from ..utils import log
 from .distributed import (DistributedDataParallelLearner,
                           distributed_binned_dataset, global_mesh)
@@ -40,6 +43,7 @@ def _allreduce_sum(vals: Sequence[float]) -> np.ndarray:
     """Scalar sums across processes (reference:
     Network::GlobalSyncUpBySum, include/LightGBM/network.h:189)."""
     from jax.experimental import multihost_utils
+    obs.inc("dtrain/allreduce_sum")
     arr = np.asarray(vals, dtype=np.float64).reshape(1, -1)
     # float64 survives as two int32 words (x64 may be disabled)
     bits = np.ascontiguousarray(arr).view(np.int32)
@@ -64,13 +68,15 @@ def train(params: Dict, local_X: np.ndarray, local_y: np.ndarray,
     (``local_group`` per process), like the reference's pre-partitioned
     distributed data (config.h pre_partition)."""
     config = Config.from_params(params)
+    obs_health.record_backend_once(source="dtrain")
     local_X = np.asarray(local_X, dtype=np.float64)
     local_y = np.asarray(local_y, dtype=np.float64)
     n_local = local_X.shape[0]
 
-    ds = distributed_binned_dataset(local_X, config, label=local_y,
-                                    weights=local_weight,
-                                    group=local_group)
+    with obs.scope("io::distributed_binning"):
+        ds = distributed_binned_dataset(local_X, config, label=local_y,
+                                        weights=local_weight,
+                                        group=local_group)
     mesh = mesh if mesh is not None else global_mesh()
     learner = DistributedDataParallelLearner(config, ds, mesh)
 
@@ -151,23 +157,39 @@ def train(params: Dict, local_X: np.ndarray, local_y: np.ndarray,
                     (n_local, 1))                       # [n, K]
     lr = float(config.learning_rate)
     trees = []
+    import time as _time
     for it in range(num_boost_round):
-        sc = jnp.asarray(score[:, 0] if K == 1 else score,
-                         dtype=jnp.float32)
-        grad, hess = objective.get_gradients(sc)
-        g = np.asarray(grad, np.float32).reshape(n_local, K)
-        h = np.asarray(hess, np.float32).reshape(n_local, K)
+        t_it = _time.perf_counter()
+        with obs.scope("gbdt::gradients"):
+            sc = jnp.asarray(score[:, 0] if K == 1 else score,
+                             dtype=jnp.float32)
+            grad, hess = objective.get_gradients(sc)
+            g = np.asarray(grad, np.float32).reshape(n_local, K)
+            h = np.asarray(hess, np.float32).reshape(n_local, K)
+        iter_trees = []
         for k in range(K):
-            tree, part = learner.train(g[:, k], h[:, k])
+            with obs.scope("tree::grow"):
+                tree, part = learner.train(g[:, k], h[:, k])
             tree.apply_shrinkage(lr)
-            local_leaf = learner.local_leaf_assignment(part)
-            score[:, k] += tree.leaf_value[local_leaf]
+            with obs.scope("gbdt::score_update"):
+                local_leaf = learner.local_leaf_assignment(part)
+                score[:, k] += tree.leaf_value[local_leaf]
             if it == 0 and abs(init_scores[k]) > 1e-35:
                 # fold the init score into the first tree so saved
                 # models predict standalone (reference: gbdt.cpp
                 # new_tree->AddBias)
                 tree.add_bias(init_scores[k])
             trees.append(tree)
+            iter_trees.append(tree)
+        if obs_events.enabled():
+            obs_events.emit(
+                "train_iter", iter=it + 1,
+                seconds=round(_time.perf_counter() - t_it, 6),
+                distributed=True,
+                trees=[{"num_leaves": int(t.num_leaves),
+                        "depth": int(t.leaf_depth[
+                            :max(t.num_leaves, 1)].max())}
+                       for t in iter_trees])
         if config.metric and (it + 1) % max(config.metric_freq, 1) == 0 \
                 and config.is_provide_training_metric:
             for mname in config.metric:

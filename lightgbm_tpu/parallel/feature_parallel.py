@@ -19,6 +19,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..io.dataset import BinnedDataset
+from ..obs.registry import registry as obs
 from ..ops.split import FeatureMeta
 from .data_parallel import DataParallelTreeLearner
 
@@ -50,8 +51,9 @@ class FeatureParallelTreeLearner(DataParallelTreeLearner):
         # rows replicated, features sharded
         self.R = N
         self.F_pad = Fp
-        self.bins = jax.device_put(
-            bins_host, NamedSharding(mesh, P(None, self.axis)))
+        with obs.scope("io::stage_bins_device"):
+            self.bins = jax.device_put(
+                bins_host, NamedSharding(mesh, P(None, self.axis)))
         self.row_sharding = NamedSharding(mesh, P())  # rows replicated
         # feature metadata padded to Fp: padded features are trivial
         # (num_bin 1 → never valid thresholds)

@@ -101,9 +101,11 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
             has_split = jnp.isfinite(gains[top_ids]).astype(jnp.int32)
             votes = jnp.zeros(F, dtype=jnp.int32) \
                 .at[top_ids].add(has_split)
-            votes = jax.lax.psum(votes, axis)               # [F] i32 — tiny
+            with jax.named_scope("obs_psum_votes"):
+                votes = jax.lax.psum(votes, axis)           # [F] i32 — tiny
             _, voted = jax.lax.top_k(votes, V)              # replicated ids
-            hv = jax.lax.psum(h[voted], axis)               # [V, B, 4] — the
+            with jax.named_scope("obs_psum_voted_hist"):
+                hv = jax.lax.psum(h[voted], axis)           # [V, B, 4] — the
             #                                    reduced histogram traffic
             full = jnp.zeros((F, B, 4), jnp.float32).at[voted].set(hv)
             vmask = jnp.zeros(F, dtype=bool).at[voted].set(True)

@@ -51,6 +51,8 @@ import numpy as np
 from ..io.binning import MissingType
 from ..io.dataset import BinnedDataset
 from ..models.tree import Tree
+from ..obs import compile as obs_compile
+from ..obs.registry import registry as obs
 from ..ops.histogram import (build_histogram, subtract_histogram,
                              unpack_bundle_histogram)
 from ..ops.split import (FeatureMeta, SplitInfo, SplitParams,
@@ -486,7 +488,7 @@ def _root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
                                 children_allowed)
         return state, _record_at(state, 0)
 
-    return jax.jit(root)
+    return jax.jit(obs_compile.traced("serial.root")(root))
 
 
 @functools.lru_cache(maxsize=None)
@@ -510,7 +512,8 @@ def _step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best)
 
-    return jax.jit(step, donate_argnums=(1,))
+    return jax.jit(obs_compile.traced("serial.step")(step),
+                   donate_argnums=(1,))
 
 
 def _cegb_penalty(params, count, used, coupled, unfetched, lazy):
@@ -552,7 +555,7 @@ def _cegb_root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
                                 children_allowed)
         return state, _record_at(state, 0)
 
-    return jax.jit(root)
+    return jax.jit(obs_compile.traced("serial.cegb_root")(root))
 
 
 @functools.lru_cache(maxsize=None)
@@ -611,7 +614,8 @@ def _cegb_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), used2, fetched2
 
-    return jax.jit(step, donate_argnums=(1,))
+    return jax.jit(obs_compile.traced("serial.cegb_step")(step),
+                   donate_argnums=(1,))
 
 
 @functools.lru_cache(maxsize=None)
@@ -640,7 +644,8 @@ def _mono_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), state.gain
 
-    return jax.jit(step, donate_argnums=(1,))
+    return jax.jit(obs_compile.traced("serial.mono_step")(step),
+                   donate_argnums=(1,))
 
 
 @functools.lru_cache(maxsize=None)
@@ -663,7 +668,8 @@ def _rescan_fn_cached(B: int, has_cat: bool = True):
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), state.gain
 
-    return jax.jit(rescan, donate_argnums=(0,))
+    return jax.jit(obs_compile.traced("serial.rescan")(rescan),
+                   donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=None)
@@ -688,7 +694,8 @@ def _adv_rescan_fn_cached(B: int, has_cat: bool = True):
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), state.gain
 
-    return jax.jit(rescan, donate_argnums=(0,))
+    return jax.jit(obs_compile.traced("serial.adv_rescan")(rescan),
+                   donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=None)
@@ -739,7 +746,8 @@ def _forced_fn_cached(S: int, B: int, Bg: int, bundled: bool,
                             rand_seed=rand_seed)
         return state, rec, ok
 
-    return jax.jit(forced, donate_argnums=(1,))
+    return jax.jit(obs_compile.traced("serial.forced")(forced),
+                   donate_argnums=(1,))
 
 
 @functools.lru_cache(maxsize=None)
@@ -774,7 +782,8 @@ def _batch_fn_cached(S: int, kb: int, B: int, Bg: int, bundled: bool,
             0, kb, body, (state, _empty_records(kb, B)))
         return state, recs
 
-    return jax.jit(batch, donate_argnums=(1,))
+    return jax.jit(obs_compile.traced("serial.batch")(batch),
+                   donate_argnums=(1,))
 
 
 class SerialTreeLearner(CapabilityMixin):
@@ -812,7 +821,8 @@ class SerialTreeLearner(CapabilityMixin):
         bins_host = np.zeros((self.R, self.Gp if self._bundled
                               else self.Fp), dtype=dataset.bins.dtype)
         bins_host[:N, :ncols if self._bundled else F] = dataset.bins
-        self.bins = jnp.asarray(bins_host)
+        with obs.scope("io::stage_bins_device"):
+            self.bins = jnp.asarray(bins_host)
         self._leaf_of_row0 = jnp.concatenate([
             jnp.zeros(N, dtype=jnp.int32),
             jnp.full((self.R - N,), -1, dtype=jnp.int32)])
@@ -979,13 +989,20 @@ class SerialTreeLearner(CapabilityMixin):
         Tree and the final [N] row→leaf assignment (device) for score
         updates (reference: GBDT::UpdateScore uses the learner's partition,
         src/boosting/gbdt.cpp:475)."""
-        ind = jnp.ones(self.N, dtype=jnp.float32) if bag is None else bag
-        gh = jnp.stack([grad * ind, hess * ind, ind,
-                        jnp.ones(self.N, dtype=jnp.float32)], axis=1)
-        gh = jnp.concatenate(
-            [gh, jnp.zeros((self.R - self.N, 4), dtype=jnp.float32)],
-            axis=0)
-        feature_mask = self._sample_features()
+        with obs.scope("tree::stage_gh"):
+            ind = jnp.ones(self.N, dtype=jnp.float32) if bag is None \
+                else bag
+            gh = jnp.stack([grad * ind, hess * ind, ind,
+                            jnp.ones(self.N, dtype=jnp.float32)], axis=1)
+            gh = jnp.concatenate(
+                [gh, jnp.zeros((self.R - self.N, 4), dtype=jnp.float32)],
+                axis=0)
+            if obs.fence():
+                # fence so the staging cost lands in THIS stage, not in
+                # whichever later scope first synchronizes (the tunnel's
+                # async dispatch smears phases otherwise)
+                jax.block_until_ready(gh)
+            feature_mask = self._sample_features()
 
         tree = Tree(self.L)
         # per-tree extra_trees seed (traced, so no retrace per tree)
@@ -999,10 +1016,13 @@ class SerialTreeLearner(CapabilityMixin):
             state = train_monotone(self, tree, gh, feature_mask,
                                    rand_seed)
             return tree, state.leaf_of_row[:self.N]
-        state, rec = self._root_fn(self.bins, gh, self._leaf_of_row0,
-                                   feature_mask, self._splittable(0),
-                                   rand_seed, self.meta, self.params,
-                                   self._btab)
+        with obs.scope("tree::root_histogram"):
+            state, rec = self._root_fn(self.bins, gh, self._leaf_of_row0,
+                                       feature_mask, self._splittable(0),
+                                       rand_seed, self.meta, self.params,
+                                       self._btab)
+            if obs.fence():
+                jax.block_until_ready(rec)
         leaf_total = {0: float(self.N)}
         next_leaf = 1
         if self._forced is not None:
@@ -1031,21 +1051,26 @@ class SerialTreeLearner(CapabilityMixin):
             S = self._bucket(M / 2)
             fn, kb = self._batch_fn(S)
             max_splits = min(kb, self.L - next_leaf)
-            state, recs = fn(self.bins, state, jnp.int32(next_leaf),
-                             jnp.int32(max_splits), feature_mask,
-                             rand_seed, self.meta, self.params,
-                             self._btab)
-            recs_h = jax.device_get(recs)
+            # split_batches = per-leaf child histogram + best-split scan
+            # steps fused into one dispatch; the device_get is the
+            # per-batch sync, so the scope covers the real device time
+            with obs.scope("tree::split_batches"):
+                state, recs = fn(self.bins, state, jnp.int32(next_leaf),
+                                 jnp.int32(max_splits), feature_mask,
+                                 rand_seed, self.meta, self.params,
+                                 self._btab)
+                recs_h = jax.device_get(recs)
             stop = False
-            for i in range(max_splits):
-                r = jax.tree_util.tree_map(lambda a: a[i], recs_h)
-                if not record_is_valid(r):
-                    stop = True
-                    break
-                apply_split_record(tree, self.dataset, r)
-                leaf_total[int(r.leaf)] = float(r.left_total_count)
-                leaf_total[next_leaf] = float(r.right_total_count)
-                next_leaf += 1
+            with obs.scope("tree::apply_records"):
+                for i in range(max_splits):
+                    r = jax.tree_util.tree_map(lambda a: a[i], recs_h)
+                    if not record_is_valid(r):
+                        stop = True
+                        break
+                    apply_split_record(tree, self.dataset, r)
+                    leaf_total[int(r.leaf)] = float(r.left_total_count)
+                    leaf_total[next_leaf] = float(r.right_total_count)
+                    next_leaf += 1
             if stop:
                 break
         return state

@@ -72,5 +72,30 @@ def warning(msg: str, *args) -> None:
         _emit("[LightGBM-TPU] [Warning] " + (msg % args if args else msg))
 
 
+def always(msg: str, *args) -> None:
+    """Emit regardless of verbosity. For output the user explicitly
+    asked for (the LIGHTGBM_TPU_TIMETAG stage table) — the analogue of
+    the reference's USE_TIMETAG dump printing even in quiet builds."""
+    _emit("[LightGBM-TPU] [Info] " + (msg % args if args else msg))
+
+
+def warning_always(msg: str, *args) -> None:
+    """Warning that ignores the verbosity gate — for degradations that
+    must never be silent (backend fallback). verbosity=-1 callers (the
+    bench) would otherwise swallow exactly the message the telemetry
+    layer exists to surface."""
+    _emit("[LightGBM-TPU] [Warning] " + (msg % args if args else msg))
+
+
 def fatal(msg: str, *args) -> None:
-    raise LightGBMError(msg % args if args else msg)
+    """Log then raise (reference: Log::Fatal prints to stderr before
+    aborting, include/LightGBM/utils/log.h:178 — a registered sink must
+    see fatal messages too, not just the exception)."""
+    msg = msg % args if args else msg
+    _emit("[LightGBM-TPU] [Fatal] " + msg)
+    try:
+        from ..obs import events as _events
+        _events.emit("log_fatal", message=msg)
+    except Exception:
+        pass
+    raise LightGBMError(msg)

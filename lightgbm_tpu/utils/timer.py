@@ -1,92 +1,19 @@
-"""Tracing/profiling scopes.
+"""Back-compat shim: the stage timer moved into the telemetry subsystem.
 
-Equivalent of the reference's ``Common::Timer``/``FunctionTimer``
-(reference: include/LightGBM/utils/common.h:973,1037 — RAII scopes around
-every pipeline stage, aggregated table printed at exit when built with
-USE_TIMETAG). The TPU twist: scopes also open ``jax.profiler.TraceAnnotation``
-ranges so stages show up in TensorBoard/perfetto device traces.
+The ``Timer``/``global_timer`` API (reference: ``Common::Timer``/
+``FunctionTimer``, include/LightGBM/utils/common.h:973,1037) is now the
+metrics registry's stage timer — :mod:`lightgbm_tpu.obs.registry` — which
+also fixes the old per-scope ``import jax.profiler`` (the module is
+resolved once at first use and the failure cached, so per-leaf scopes in
+the hot tree-growth loop skip Python import machinery entirely).
 
-Enable with ``LIGHTGBM_TPU_TIMETAG=1`` (the analogue of -DUSE_TIMETAG) or
-``global_timer.enable()``; print with ``global_timer.print_summary()``.
+``global_timer`` here IS the registry's timer: enabling/printing through
+either name observes the same aggregation.
 """
 from __future__ import annotations
 
-import atexit
-import os
-import time
-from collections import defaultdict
-from contextlib import contextmanager
-from typing import Dict, Optional
+from ..obs.registry import (StageTimer as Timer,  # noqa: F401
+                            registry, start_device_trace,
+                            stop_device_trace)
 
-from . import log
-
-
-class Timer:
-    def __init__(self) -> None:
-        self.enabled = bool(int(os.environ.get("LIGHTGBM_TPU_TIMETAG", "0")))
-        self.totals: Dict[str, float] = defaultdict(float)
-        self.counts: Dict[str, int] = defaultdict(int)
-        self._printed = False
-
-    def enable(self) -> None:
-        self.enabled = True
-
-    def disable(self) -> None:
-        self.enabled = False
-
-    @contextmanager
-    def scope(self, name: str):
-        """RAII stage scope (reference: FunctionTimer, common.h:1037)."""
-        if not self.enabled:
-            yield
-            return
-        annotation = None
-        try:
-            import jax.profiler
-            annotation = jax.profiler.TraceAnnotation(name)
-            annotation.__enter__()
-        except Exception:
-            annotation = None
-        start = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.totals[name] += time.perf_counter() - start
-            self.counts[name] += 1
-            if annotation is not None:
-                annotation.__exit__(None, None, None)
-
-    def print_summary(self) -> None:
-        """reference: Timer::Print (common.h:1006) — per-stage totals."""
-        if not self.totals:
-            return
-        width = max(len(k) for k in self.totals)
-        log.info("%s" % ("-" * (width + 30)))
-        log.info("%-*s %12s %8s" % (width, "stage", "seconds", "calls"))
-        for name in sorted(self.totals, key=lambda k: -self.totals[k]):
-            log.info("%-*s %12.6f %8d"
-                     % (width, name, self.totals[name], self.counts[name]))
-
-    def reset(self) -> None:
-        self.totals.clear()
-        self.counts.clear()
-
-
-global_timer = Timer()
-
-
-@atexit.register
-def _print_at_exit() -> None:
-    if global_timer.enabled:
-        global_timer.print_summary()
-
-
-def start_device_trace(logdir: str) -> None:
-    """Start a jax profiler trace (device timeline → TensorBoard)."""
-    import jax.profiler
-    jax.profiler.start_trace(logdir)
-
-
-def stop_device_trace() -> None:
-    import jax.profiler
-    jax.profiler.stop_trace()
+global_timer = registry.timer

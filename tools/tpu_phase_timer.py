@@ -3,20 +3,22 @@
 Answers "where does the tree-build time go" on real hardware: gradient
 computation, gh staging, root dispatch, whole-tree dispatch, record
 read-back, score update — each fenced with block_until_ready so the
-tunnel's async dispatch can't smear phases together. The reference's
+tunnel's async dispatch can't smear phases together. The phases are
+recorded through the telemetry registry (lightgbm_tpu/obs) — the same
+stage timer the trainer itself uses — so this tool is the registry's
+hardware consumer, not a parallel hand-rolled timer. The reference's
 equivalent is its per-tree timer dump (src/treelearner/
 serial_tree_learner.cpp Global timer); here the phases map to the
 mesh learner's actual dispatch structure (parallel/data_parallel.py
 train()).
 
 Usage:  python tools/tpu_phase_timer.py [rows] [n_trees]
-Prints one JSON line per tree plus a summary.
+Prints one JSON line per tree plus a summary (registry snapshot).
 """
 from __future__ import annotations
 
 import json
 import sys
-import time
 
 sys.path.insert(0, __import__("os").path.join(
     __import__("os").path.dirname(__import__("os").path.abspath(__file__)),
@@ -34,8 +36,12 @@ def main() -> None:
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.io.dataset import BinnedDataset
     from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.obs import health as obs_health
+    from lightgbm_tpu.obs.registry import registry
 
     _enable_compile_cache()
+    registry.enable()
+    obs_health.record_backend(source="tpu_phase_timer")
     print(json.dumps({"phase": "devices",
                       "platform": jax.devices()[0].platform}), flush=True)
 
@@ -45,10 +51,12 @@ def main() -> None:
         "learning_rate": 0.1, "verbosity": -1, "min_data_in_leaf": 100,
         "tree_learner": "data", "mesh_shape": "data=1",
     })
-    t0 = time.time()
-    ds = BinnedDataset.from_matrix(X, cfg, label=y)
-    print(json.dumps({"phase": "binned", "s": round(time.time() - t0, 2)}),
-          flush=True)
+    with registry.scope("phase::binned"):
+        ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    print(json.dumps(
+        {"phase": "binned",
+         "s": round(registry.timer.totals["phase::binned"], 2)}),
+        flush=True)
     del X
 
     booster = create_boosting(cfg, ds)
@@ -56,58 +64,51 @@ def main() -> None:
     objective = booster.objective
 
     # one full warmup iteration compiles everything
-    t0 = time.time()
-    booster.train_one_iter()
-    jax.block_until_ready(booster.train_score)
-    print(json.dumps({"phase": "warmup_iter",
-                      "s": round(time.time() - t0, 2)}), flush=True)
+    with registry.scope("phase::warmup_iter"):
+        booster.train_one_iter()
+        jax.block_until_ready(booster.train_score)
+    print(json.dumps(
+        {"phase": "warmup_iter",
+         "s": round(registry.timer.totals["phase::warmup_iter"], 2)}),
+        flush=True)
 
-    def fence(x):
-        jax.block_until_ready(x)
-        return time.time()
+    def fenced(name, fn):
+        """Run fn under a registry stage scope with a device fence so
+        the async dispatch cost lands in ITS stage."""
+        with registry.scope(name):
+            out = fn()
+            jax.block_until_ready(out)
+        return out
 
-    totals: dict = {}
+    PHASES = ("phase::grad", "phase::stage_gh", "phase::root_fn",
+              "phase::tree_fn", "phase::readback")
     for k in range(n_trees):
-        rec = {}
-        t = time.time()
-        # same call shape as GBDT.train_one_iter (boosting/gbdt.py:293)
-        grad, hess = objective.get_gradients(booster.train_score[:, 0])
-        t2 = fence((grad, hess))
-        rec["grad"] = t2 - t
-
-        t = t2
-        gh = learner._make_gh(grad, hess, None)
-        t2 = fence(gh)
-        rec["stage_gh"] = t2 - t
-
-        t = t2
+        before = {p: registry.timer.totals.get(p, 0.0) for p in PHASES}
+        # same call shape as GBDT.train_one_iter (boosting/gbdt.py)
+        grad, hess = fenced("phase::grad", lambda: objective.get_gradients(
+            booster.train_score[:, 0]))
+        gh = fenced("phase::stage_gh",
+                    lambda: learner._make_gh(grad, hess, None))
         feature_mask = learner._sample_features()
-        state, root_rec = learner._root_fn(learner.bins, gh, feature_mask,
-                                           jnp.int32(k + 1))
-        t2 = fence(root_rec)
-        rec["root_fn"] = t2 - t
+        state, root_rec = fenced("phase::root_fn", lambda: learner._root_fn(
+            learner.bins, gh, feature_mask, jnp.int32(k + 1)))
+        state, recs = fenced("phase::tree_fn", lambda: learner._tree_fn(
+            learner.bins, state, feature_mask, jnp.int32(k + 1)))
+        with registry.scope("phase::readback"):
+            jax.device_get(recs)
 
-        t = t2
-        state, recs = learner._tree_fn(learner.bins, state, feature_mask,
-                                       jnp.int32(k + 1))
-        t2 = fence(recs)
-        rec["tree_fn"] = t2 - t
-
-        t = t2
-        jax.device_get(recs)
-        t2 = time.time()
-        rec["readback"] = t2 - t
-
-        rec = {k2: round(v, 4) for k2, v in rec.items()}
+        rec = {p.split("::", 1)[1]:
+               round(registry.timer.totals.get(p, 0.0) - before[p], 4)
+               for p in PHASES}
         rec["tree"] = k
         print(json.dumps(rec), flush=True)
-        for k2, v in rec.items():
-            if isinstance(v, float):
-                totals[k2] = totals.get(k2, 0.0) + v
 
-    summary = {k2: round(v / n_trees, 4) for k2, v in totals.items()}
+    summary = {p.split("::", 1)[1]:
+               round(registry.timer.totals.get(p, 0.0) / n_trees, 4)
+               for p in PHASES}
     summary["phase"] = "mean_per_tree"
     summary["rows"] = rows
+    summary["registry"] = registry.snapshot()
     print(json.dumps(summary), flush=True)
 
 
