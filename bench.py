@@ -144,6 +144,42 @@ def _enable_compile_cache() -> None:
         pass
 
 
+def _bench_predict(booster, n_feat: int) -> dict:
+    """Predict-throughput stage: rows/sec through the stacked-forest
+    serving path (lightgbm_tpu/serve): one jitted dispatch quantizes raw
+    rows and walks the whole trained forest, f32 device-side sum. A
+    failure here must not lose the training result — the caller treats
+    a zero as 'stage failed' (bench_stages.jsonl carries the reason)."""
+    rows = int(os.environ.get("BENCH_PREDICT_ROWS", 1 << 18))
+    budget = float(os.environ.get("BENCH_PREDICT_BUDGET", 60))
+    n_disp = int(os.environ.get("BENCH_PREDICT_DISPATCHES", 8))
+    try:
+        import jax
+        from lightgbm_tpu.serve import StackedForest
+        Xp, _ = make_higgs_like(rows, n_feat, seed=1)
+        forest = StackedForest.from_gbdt(booster)
+        _stage("predict_start", rows=rows, trees=forest.num_trees)
+        # warm the single (bucket, forest-shape) compile out of the
+        # measurement
+        jax.block_until_ready(forest.predict_raw_device(Xp))
+        t0 = time.time()
+        done = 0
+        for _ in range(max(n_disp, 1)):
+            jax.block_until_ready(forest.predict_raw_device(Xp))
+            done += rows
+            if time.time() - t0 > budget:
+                break
+        rps = done / max(time.time() - t0, 1e-9)
+        _stage("predict", rows=rows, dispatches=done // rows,
+               rows_per_sec=round(rps, 1))
+        return {"predict_rows_per_sec": round(rps, 1),
+                "predict_rows": rows}
+    except Exception as e:  # noqa: BLE001 — keep the training result
+        _stage("predict_failed",
+               detail="%s: %s" % (type(e).__name__, str(e)[:300]))
+        return {"predict_rows_per_sec": 0.0, "predict_rows": rows}
+
+
 def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
     if n_rows is None:
         n_rows = int(os.environ.get("BENCH_ROWS", HIGGS_ROWS))
@@ -257,6 +293,10 @@ def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
     auc = m.eval(np.asarray(booster.train_score[:, 0]),
                  booster.objective)[0]
 
+    # serving throughput through the trained forest (ISSUE 2: a
+    # first-class predict stage, not an afterthought of training)
+    predict_res = _bench_predict(booster, booster.max_feature_idx + 1)
+
     # record which histogram kernel actually ran (the Pallas path
     # self-probes and may fall back; CPU auto-selects the segment-sum
     # scatter path)
@@ -285,6 +325,10 @@ def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
         "backend": platform,
         "backend_fallback": fallback or None,
         "phases": obs_registry.phases(),
+        # serving throughput (rows/sec through serve.StackedForest's
+        # whole-forest dispatch at BENCH_PREDICT_ROWS scale)
+        "predict_rows_per_sec": predict_res["predict_rows_per_sec"],
+        "predict_rows": predict_res["predict_rows"],
     }
 
 

@@ -18,8 +18,6 @@ from .boosting.gbdt import GBDT
 from .config import Config
 from .io.dataset import BinnedDataset
 from .metric import create_metric, resolve_metric_names
-from .obs import events as obs_events
-from .obs.registry import registry as obs
 from .utils import log
 
 _ArrayLike = Union[np.ndarray, Sequence]
@@ -322,14 +320,12 @@ class Booster:
 
     def _eval(self, valid_idx: Optional[int], name: str,
               feval=None) -> List[Tuple]:
-        with obs.scope("gbdt::eval_metrics"):
-            out = self._eval_inner(valid_idx, name, feval)
-        if out and obs_events.enabled():
-            obs_events.emit("eval", iter=self.inner.iter,
-                            results=[{"dataset": ds, "metric": mname,
-                                      "value": float(v)}
-                                     for ds, mname, v, _ in out])
-        return out
+        # one eval pass = one gbdt::eval_metrics scope + one `eval`
+        # event, via the shared instrumentation point in boosting/gbdt.py
+        from .boosting.gbdt import run_instrumented_eval
+        return run_instrumented_eval(
+            self.inner.iter,
+            lambda: self._eval_inner(valid_idx, name, feval))
 
     def _eval_inner(self, valid_idx: Optional[int], name: str,
                     feval=None) -> List[Tuple]:
@@ -397,9 +393,82 @@ class Booster:
             return self.inner.predict_leaf_index(X, start_iteration, ni)
         if pred_contrib:
             return self.inner.predict_contrib(X, start_iteration, ni)
+        out = self._predict_stacked(X, start_iteration, ni, raw_score,
+                                    kwargs)
+        if out is not None:
+            return out
         return self.inner.predict(X, raw_score=raw_score,
                                   start_iteration=start_iteration,
                                   num_iteration=ni)
+
+    # batches below this ride the host walk — a device dispatch (plus a
+    # possible first-bucket compile) only pays off on real batches
+    _kDeviceMinRows = 256
+
+    def _predict_stacked(self, X: np.ndarray, start_iteration: int,
+                         num_iteration: int, raw_score: bool,
+                         kwargs: Dict) -> Optional[np.ndarray]:
+        """Fast path: one device dispatch through serve.StackedForest
+        (bucketed compile cache kept across calls). Returns None — fall
+        back to the host walk — whenever the stacked path cannot
+        reproduce the host result BIT-FOR-BIT: linear leaves,
+        pred_early_stop, f64 rows the f32 quantizer cannot represent
+        exactly, feature-count mismatch, or mixed per-feature missing
+        types (text-loaded edge case)."""
+        forced = kwargs.get("predict_on_device")
+        if forced is not None and not forced:
+            return None
+        if forced is None:
+            # auto mode: only worth it where a device dispatch beats the
+            # vectorized host walk — real batches on an accelerator. On
+            # CPU backends the walk is the same XLA gathers plus compile
+            # overhead, so auto stays off (kwarg True still forces).
+            if (not self.config.predict_on_device
+                    or X.shape[0] < self._kDeviceMinRows):
+                return None
+            import jax
+            if jax.default_backend() == "cpu":
+                return None
+        if self.config.pred_early_stop or kwargs.get("pred_early_stop"):
+            return None
+        inner = self.inner
+        models = inner._used_models(start_iteration, num_iteration)
+        if not models or any(t.is_linear for t in models):
+            return None
+        if X.shape[1] != inner.max_feature_idx + 1:
+            return None
+        if not np.all((X.astype(np.float32).astype(np.float64) == X)
+                      | np.isnan(X)):
+            return None  # rows exceed f32 precision: exactness would break
+        # cache the packed forest until the model slice changes. Object
+        # identity is not enough: refit and DART normalization mutate
+        # leaf values IN PLACE, so the key fingerprints the leaf
+        # contents (O(total leaves), ~1ms at 500x255 — cheap next to a
+        # >=256-row predict)
+        import hashlib
+        fp = hashlib.blake2b(digest_size=8)
+        for t in models:
+            fp.update(t.leaf_value[:t.num_leaves].tobytes())
+        key = (len(inner.models), fp.hexdigest(),
+               start_iteration, num_iteration)
+        cached = getattr(self, "_stacked_cache", None)
+        if cached is None or cached[0] != key:
+            from .serve import BucketedPredictor, StackedForest
+            try:
+                forest = StackedForest.from_gbdt(inner, start_iteration,
+                                                 num_iteration)
+            except ValueError:
+                self._stacked_cache = (key, None)
+                return None
+            self._stacked_cache = (key, BucketedPredictor(
+                forest, model_version=key))
+            cached = self._stacked_cache
+        predictor = cached[1]
+        if predictor is None:
+            return None
+        kind = ("raw" if raw_score or inner.objective is None
+                else "value")
+        return predictor.predict(X, output_kind=kind)
 
     # ------------------------------------------------------------------
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
@@ -443,6 +512,7 @@ class Booster:
         state["_model_str"] = self.model_to_string(num_iteration=-1)
         state.pop("inner", None)
         state.pop("_train_set", None)
+        state.pop("_stacked_cache", None)  # device arrays don't pickle
         return state
 
     def __setstate__(self, state):

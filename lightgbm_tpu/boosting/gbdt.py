@@ -39,6 +39,23 @@ kEpsilon = 1e-15
 _K_MIN_SCORE = -np.inf
 
 
+def run_instrumented_eval(iter_idx: int, compute):
+    """THE instrumentation point for metric evaluation: every eval path
+    (``Booster._eval`` and the CLI loop's ``GBDT.eval_metrics``) funnels
+    through here, so one evaluation pass = exactly one
+    ``gbdt::eval_metrics`` stage scope + one ``eval`` event. Previously
+    both paths carried their own copy of this wrapper (ROADMAP open
+    item: double instrumentation)."""
+    with obs.scope("gbdt::eval_metrics"):
+        out = compute()
+    if out and obs_events.enabled():
+        obs_events.emit("eval", iter=iter_idx,
+                        results=[{"dataset": ds, "metric": name,
+                                  "value": float(v)}
+                                 for ds, name, v, _ in out])
+    return out
+
+
 def _device_tree_outputs(tree: Tree, bins_dev, dataset: BinnedDataset,
                          bin_meta):
     """Device [n] f32 per-row output of one tree over the dataset's
@@ -596,30 +613,27 @@ class GBDT:
     def eval_metrics(self) -> List[Tuple[str, str, float, bool]]:
         """Evaluate all metrics; returns (dataset_name, metric_name,
         value, is_bigger_better) tuples."""
+        return run_instrumented_eval(self.iter, self._eval_metrics_inner)
+
+    def _eval_metrics_inner(self) -> List[Tuple[str, str, float, bool]]:
         out = []
-        with obs.scope("gbdt::eval_metrics"):
-            if self.train_metrics:
-                score = np.asarray(self.train_score, dtype=np.float64)
-                score = score[:, 0] if self.num_tree_per_iteration == 1 \
-                    else score
-                for m in self.train_metrics:
-                    for name, v in zip(m.name,
-                                       m.eval(score, self.objective)):
-                        out.append(("training", name, v,
-                                    m.factor_to_bigger_better > 0))
-            for i, vd in enumerate(self.valid_data):
-                score = vd.scores[:, 0] \
-                    if self.num_tree_per_iteration == 1 else vd.scores
-                for m in vd.metrics:
-                    for name, v in zip(m.name,
-                                       m.eval(score, self.objective)):
-                        out.append(("valid_%d" % i, name, v,
-                                    m.factor_to_bigger_better > 0))
-        if out and obs_events.enabled():
-            obs_events.emit("eval", iter=self.iter,
-                            results=[{"dataset": ds, "metric": name,
-                                      "value": float(v)}
-                                     for ds, name, v, _ in out])
+        if self.train_metrics:
+            score = np.asarray(self.train_score, dtype=np.float64)
+            score = score[:, 0] if self.num_tree_per_iteration == 1 \
+                else score
+            for m in self.train_metrics:
+                for name, v in zip(m.name,
+                                   m.eval(score, self.objective)):
+                    out.append(("training", name, v,
+                                m.factor_to_bigger_better > 0))
+        for i, vd in enumerate(self.valid_data):
+            score = vd.scores[:, 0] \
+                if self.num_tree_per_iteration == 1 else vd.scores
+            for m in vd.metrics:
+                for name, v in zip(m.name,
+                                   m.eval(score, self.objective)):
+                    out.append(("valid_%d" % i, name, v,
+                                m.factor_to_bigger_better > 0))
         return out
 
     def _check_early_stopping(self, eval_list) -> bool:

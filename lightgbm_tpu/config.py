@@ -293,6 +293,11 @@ class Config:
     pred_early_stop: bool = False
     pred_early_stop_freq: int = 10
     pred_early_stop_margin: float = 10.0
+    # TPU addition: allow Booster.predict to route large batches through
+    # the stacked-forest device path (serve/) when it can reproduce the
+    # host walk bit-for-bit; per-call override via the
+    # ``predict_on_device`` predict kwarg
+    predict_on_device: bool = True
     output_result: str = "LightGBM_predict_result.txt"
     convert_model_language: str = ""
     convert_model: str = "gbdt_prediction.cpp"

@@ -440,6 +440,36 @@ class Tree:
         return t
 
     # ------------------------------------------------------------------
+    def cat_value_words(self, cat_idx: int) -> int:
+        """Bitset word count of one categorical split — bounds the
+        largest category value the node can send left."""
+        return self.cat_boundaries[cat_idx + 1] - self.cat_boundaries[cat_idx]
+
+    def cat_value_mask(self, cat_idx: int, max_value: int) -> np.ndarray:
+        """[max_value+1] bool: membership of category values 0..max_value
+        in the split's bitset (vectorized FindInBitset). Works on
+        text-loaded trees — only cat_boundaries/cat_threshold needed."""
+        vals = np.arange(max_value + 1, dtype=np.float64)
+        return self._cat_contains(cat_idx, vals)
+
+    def structure_depth(self) -> int:
+        """Max root→leaf hop count derived from the child arrays alone.
+        ``leaf_depth`` is a train-time field that text-loaded trees leave
+        zeroed, so device traversal trip counts must come from here."""
+        if self.num_leaves <= 1:
+            return 0
+        best = 0
+        stack: List[tuple] = [(0, 0)]
+        while stack:
+            idx, d = stack.pop()
+            if idx < 0:
+                best = max(best, d)
+                continue
+            stack.append((int(self.left_child[idx]), d + 1))
+            stack.append((int(self.right_child[idx]), d + 1))
+        return best
+
+    # ------------------------------------------------------------------
     def _cats_of(self, cat_idx: int) -> List[int]:
         """Expand a stored bitset back to category values (reference:
         Tree::NodeToJSON's FindInBitset loop, src/io/tree.cpp:466-477)."""

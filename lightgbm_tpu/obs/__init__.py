@@ -29,8 +29,9 @@ from .registry import MetricsRegistry, StageTimer, registry  # noqa: F401
 scope = registry.scope
 counter = registry.inc
 gauge = registry.gauge
+observe = registry.observe
 
 __all__ = [
     "MetricsRegistry", "StageTimer", "registry", "events", "health",
-    "compile_tracking", "scope", "counter", "gauge",
+    "compile_tracking", "scope", "counter", "gauge", "observe",
 ]

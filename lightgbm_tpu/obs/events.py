@@ -15,26 +15,49 @@ Two sinks, both optional and independent:
 
 Emission with no sink configured is a few dict lookups — cheap enough
 to leave the call sites unconditional.
+
+File writes are BUFFERED: emits append serialized lines to an in-memory
+buffer that flushes on overflow (``LIGHTGBM_TPU_EVENT_BUFFER`` lines,
+default 64; 0 = write-through), at process exit (atexit), on
+:func:`configure`, and on explicit :func:`flush`. This replaces the old
+per-emit open/append/close (one syscall trio per event — measurable in
+tight iteration loops at Higgs scale). Each buffered record remembers
+the sink path active when it was emitted, so late env-var changes keep
+exact per-file ordering and content; :func:`read_jsonl` flushes first,
+so readers never race the buffer. Callbacks still fire synchronously
+per emit — only the file sink is deferred.
 """
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 _ENV_VAR = "LIGHTGBM_TPU_EVENT_LOG"
+_ENV_BUFFER = "LIGHTGBM_TPU_EVENT_BUFFER"
 
 _callback: Optional[Callable[[Dict], None]] = None
 _path_override: Optional[str] = None
 _lock = threading.Lock()
+_buffer: List[Tuple[str, str]] = []  # (sink path at emit time, json line)
+
+
+def _buffer_limit() -> int:
+    try:
+        return max(int(os.environ.get(_ENV_BUFFER, "64")), 1)
+    except ValueError:
+        return 64
 
 
 def configure(path: Optional[str]) -> None:
     """Pin the event-log path programmatically (overrides the env var;
-    pass None to fall back to ``LIGHTGBM_TPU_EVENT_LOG``)."""
+    pass None to fall back to ``LIGHTGBM_TPU_EVENT_LOG``). Flushes any
+    buffered events first so readers of the previous sink are current."""
     global _path_override
+    flush()
     _path_override = path
 
 
@@ -93,16 +116,54 @@ def emit(event: str, **fields) -> Optional[Dict]:
         try:
             line = json.dumps(rec)
             with _lock:
-                with open(path, "a") as f:
-                    f.write(line + "\n")
+                _buffer.append((path, line))
+                if len(_buffer) >= _buffer_limit():
+                    _flush_locked()
         except Exception:
             pass
     return rec
 
 
+def flush() -> None:
+    """Write every buffered event to its file sink. Never raises —
+    telemetry must not take the caller down. Registered atexit; call
+    explicitly before handing a log file to an external reader."""
+    with _lock:
+        _flush_locked()
+
+
+def _flush_locked() -> None:
+    """Drain the buffer grouping CONSECUTIVE same-path records into one
+    append each, so per-file line order is exactly emission order even
+    when the sink path changed mid-buffer."""
+    if not _buffer:
+        return
+    try:
+        i = 0
+        while i < len(_buffer):
+            path = _buffer[i][0]
+            j = i
+            while j < len(_buffer) and _buffer[j][0] == path:
+                j += 1
+            try:
+                with open(path, "a") as f:
+                    f.write("\n".join(line for _, line in _buffer[i:j])
+                            + "\n")
+            except Exception:
+                pass
+            i = j
+    finally:
+        del _buffer[:]
+
+
+atexit.register(flush)
+
+
 def read_jsonl(path: str):
     """Parse an event-log file back into a list of event dicts (raises
-    on malformed lines — the test-side round-trip check)."""
+    on malformed lines — the test-side round-trip check). Flushes the
+    buffer first so in-process readers see everything emitted so far."""
+    flush()
     out = []
     with open(path) as f:
         for line in f:

@@ -63,3 +63,4 @@ def record_backend_fallback(reason: str, requested: str = "tpu",
     registry.inc("backend_fallback")
     events.emit("backend_fallback", requested=requested, actual=actual,
                 reason=reason)
+    events.flush()  # degradation evidence must survive a crash

@@ -26,6 +26,9 @@ from ..utils import log
 # jax.profiler, resolved once: None = unresolved, False = unavailable
 _profiler_mod = None
 
+# histogram reservoir bound: old samples age out past this many
+kHistCap = 4096
+
 
 def _get_profiler():
     global _profiler_mod
@@ -108,6 +111,11 @@ class MetricsRegistry:
         self.timer = StageTimer()
         self.counters: Dict[str, int] = defaultdict(int)
         self.gauges: Dict[str, float] = {}
+        # histograms: bounded value reservoirs (last kHistCap samples)
+        # + an unbounded observation counter — what the serving layer's
+        # p50/p99 latency reporting reads
+        self.hist_values: Dict[str, list] = defaultdict(list)
+        self.hist_counts: Dict[str, int] = defaultdict(int)
         self._lock = threading.Lock()
         # Profiling mode: fence (block_until_ready) at stage boundaries
         # so async dispatch can't smear one stage into the next. On only
@@ -146,6 +154,32 @@ class MetricsRegistry:
     def gauge(self, name: str, value: float) -> None:
         self.gauges[name] = value
 
+    # -- histograms -----------------------------------------------------
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample into a bounded histogram reservoir."""
+        with self._lock:
+            self.hist_counts[name] += 1
+            vals = self.hist_values[name]
+            vals.append(float(value))
+            if len(vals) > kHistCap:
+                del vals[:len(vals) - kHistCap]
+
+    def percentile(self, name: str, q: float) -> float:
+        """Linear-interpolated percentile over the reservoir (numpy's
+        default method); 0.0 when nothing was observed."""
+        with self._lock:
+            vals = sorted(self.hist_values.get(name, ()))
+        return self._percentile_of(vals, q)
+
+    @staticmethod
+    def _percentile_of(vals: list, q: float) -> float:
+        if not vals:
+            return 0.0
+        k = (len(vals) - 1) * (q / 100.0)
+        f = int(k)
+        c = min(f + 1, len(vals) - 1)
+        return vals[f] + (vals[c] - vals[f]) * (k - f)
+
     # -- aggregation ----------------------------------------------------
     def phases(self) -> Dict[str, Dict[str, float]]:
         """Machine-readable stage table: {stage: {seconds, calls}} —
@@ -155,9 +189,20 @@ class MetricsRegistry:
                 for name in self.timer.totals}
 
     def snapshot(self) -> Dict:
+        # histograms snapshot under the lock: a serving worker's first
+        # observe() of a new name must not resize the dict mid-iteration
+        with self._lock:
+            hist_data = {name: (self.hist_counts[name], sorted(vals))
+                         for name, vals in self.hist_values.items()}
+            counters = dict(self.counters)
         return {"phases": self.phases(),
-                "counters": dict(self.counters),
-                "gauges": dict(self.gauges)}
+                "counters": counters,
+                "gauges": dict(self.gauges),
+                "hists": {name: {
+                    "count": count,
+                    "p50": round(self._percentile_of(vals, 50), 6),
+                    "p99": round(self._percentile_of(vals, 99), 6)}
+                    for name, (count, vals) in hist_data.items()}}
 
     def print_summary(self) -> None:
         self.timer.print_summary()
@@ -166,6 +211,8 @@ class MetricsRegistry:
         self.timer.reset()
         with self._lock:
             self.counters.clear()
+            self.hist_values.clear()
+            self.hist_counts.clear()
         self.gauges.clear()
 
 

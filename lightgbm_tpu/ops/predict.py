@@ -178,6 +178,135 @@ def predict_leaf_on_device(bins_dev: jnp.ndarray,
     return _traverse(bins_dev, dtree, _next_pow2(dtree.depth))
 
 
+# ---------------------------------------------------------------------------
+# Stacked-forest kernels (serving): the whole forest in one dispatch.
+#
+# Where the training-side DeviceTree walks ONE tree over dataset-binned
+# rows, serving packs ALL T trees' flat node arrays into single [T, NI]
+# arrays and vmaps the same lockstep walk over the tree axis — the
+# XLA-shaped analogue of batching the forest, not the tree (the lever
+# XGBoost-GPU and the reference's CUDA scorer pull; see docs/SERVING.md).
+# Rows arrive as RAW float features and are quantized on device against
+# the model's own threshold set (serve/forest.py builds the tables), so
+# the uint gather matrix never leaves HBM between quantize and walk.
+# ---------------------------------------------------------------------------
+
+# sentinel bin ids assigned by the quantizer; they can never collide with
+# a real bin (>= 0) or a node threshold index (>= -1)
+kNanBin = -2    # NaN value on a MissingType.NAN feature
+kZeroBin = -4   # |v| <= kZeroThreshold on a MissingType.ZERO feature
+
+
+class StackedNodes(NamedTuple):
+    """All T trees' node arrays, padded to common [T, NI] / [T, NL]
+    shapes (serving analogue of DeviceTree; serve/forest.py packs it)."""
+    feat: jnp.ndarray          # [T, NI] i32 REAL feature index
+    tbin: jnp.ndarray          # [T, NI] i32 threshold rank (-1: none left)
+    default_left: jnp.ndarray  # [T, NI] bool
+    left: jnp.ndarray          # [T, NI] i32 (>=0 node, <0 ~leaf)
+    right: jnp.ndarray         # [T, NI] i32
+    is_cat: jnp.ndarray        # [T, NI] bool
+    cat_slot: jnp.ndarray      # [T, NI] i32 row of the shared cat LUT
+    leaf_value: jnp.ndarray    # [T, NL] f32
+
+
+class QuantizerTables(NamedTuple):
+    """Per-feature raw-value→bin tables derived from the model's own
+    split thresholds (serve/forest.py builds them; exact in f32)."""
+    thresholds: jnp.ndarray    # [F, M] f32 round-down thresholds, +inf pad
+    is_cat: jnp.ndarray        # [F] bool
+    nan_feat: jnp.ndarray      # [F] bool (MissingType.NAN features)
+    zero_feat: jnp.ndarray     # [F] bool (MissingType.ZERO features)
+    vmax: jnp.ndarray          # [] i32 max categorical value in the LUT
+    zero_eps: jnp.ndarray      # [] f32 round-down f32 of kZeroThreshold
+
+
+def _quantize_rows_impl(X: jnp.ndarray, qt: QuantizerTables) -> jnp.ndarray:
+    """[n, F] raw f32 rows → [n, F] i32 model-space bins.
+
+    Numeric bin = #{thresholds on f < v} — so ``bin <= rank(t)`` decides
+    exactly like the host's ``v <= t`` (thresholds are stored as the
+    largest f32 <= t, which preserves every comparison against
+    f32-representable values). NaN/zero missing semantics are resolved
+    here once per row, into sentinel bins the walk maps to default_left.
+    """
+    isnan = jnp.isnan(X)
+    # NaN behaves as 0.0 except on MissingType.NAN features (tree.py
+    # _decide: v = where(isnan & missing != NAN, 0, fval))
+    Xn = jnp.where(isnan & ~qt.nan_feat[None, :], jnp.float32(0.0), X)
+    b = jax.vmap(lambda t, col: jnp.searchsorted(t, col, side="left"),
+                 in_axes=(0, 1), out_axes=1)(qt.thresholds, Xn)
+    b = b.astype(jnp.int32)
+    b = jnp.where(qt.nan_feat[None, :] & isnan, jnp.int32(kNanBin), b)
+    b = jnp.where(qt.zero_feat[None, :] & (jnp.abs(Xn) <= qt.zero_eps),
+                  jnp.int32(kZeroBin), b)
+    # categorical: the "bin" is the category value itself, clamped into
+    # the shared LUT's row (out-of-range / negative / NaN → vmax+1, an
+    # always-False column == the host's FindInBitset miss → go right)
+    vmax = qt.vmax.astype(jnp.float32)
+    iv = jnp.clip(jnp.where(isnan, jnp.float32(-1.0), X),
+                  -1.0, vmax + 1.0).astype(jnp.int32)
+    cb = jnp.where((iv >= 0) & (iv <= qt.vmax), iv, qt.vmax + 1)
+    return jnp.where(qt.is_cat[None, :], cb, b)
+
+
+def _walk_stacked(bins: jnp.ndarray, nodes: StackedNodes,
+                  cat_lut: jnp.ndarray, trips: int) -> jnp.ndarray:
+    """[n, F] bins → [T, n] leaf ids: the DeviceTree lockstep walk,
+    vmapped over the stacked tree axis."""
+    n = bins.shape[0]
+
+    def walk_one(feat, tbin, dl, left, right, is_cat, cat_slot):
+        def body(_, node):
+            nd = jnp.maximum(node, 0)
+            f = feat[nd]
+            b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0]
+            gl = b <= tbin[nd]
+            gl = jnp.where(b == kNanBin, dl[nd], gl)
+            gl = jnp.where(b == kZeroBin, dl[nd], gl)
+            lu = cat_lut[cat_slot[nd], jnp.maximum(b, 0)]
+            gl = jnp.where(is_cat[nd], lu, gl)
+            nxt = jnp.where(gl, left[nd], right[nd])
+            return jnp.where(node >= 0, nxt, node)
+
+        node = jax.lax.fori_loop(0, trips, body,
+                                 jnp.zeros(n, dtype=jnp.int32))
+        return jnp.where(node < 0, ~node, 0).astype(jnp.int32)
+
+    return jax.vmap(walk_one)(nodes.feat, nodes.tbin, nodes.default_left,
+                              nodes.left, nodes.right, nodes.is_cat,
+                              nodes.cat_slot)
+
+
+def _stacked_leaves_body(X, qt, nodes, cat_lut, trips):
+    return _walk_stacked(_quantize_rows_impl(X, qt), nodes, cat_lut, trips)
+
+
+def _stacked_raw_body(X, qt, nodes, cat_lut, trips, K):
+    leaves = _stacked_leaves_body(X, qt, nodes, cat_lut, trips)
+    vals = jnp.take_along_axis(nodes.leaf_value, leaves, axis=1)  # [T, n]
+    # models are iteration-major: tree i contributes to class i % K
+    return vals.reshape(-1, K, vals.shape[1]).sum(axis=0).T       # [n, K]
+
+
+def _make_stacked_jits():
+    """Jitted quantize+walk entry points, trace-tracked through
+    obs/compile.py (one compile per (row-bucket, forest-shape); the
+    serve cache pads rows so a second dispatch at the same bucket hits
+    the jit cache with zero retraces)."""
+    from ..obs import compile as obs_compile
+    leaves = jax.jit(
+        obs_compile.traced("serve.stacked_leaves")(_stacked_leaves_body),
+        static_argnames=("trips",))
+    raw = jax.jit(
+        obs_compile.traced("serve.stacked_raw")(_stacked_raw_body),
+        static_argnames=("trips", "K"))
+    return leaves, raw
+
+
+stacked_forest_leaves, stacked_forest_raw = _make_stacked_jits()
+
+
 @jax.jit
 def _gather_leaf_values(leaf_value, leaf):
     return leaf_value[leaf]

@@ -1,0 +1,20 @@
+"""TPU-native batched inference: StackedForest + shape-bucketed compile
+cache + micro-batching PredictServer / model registry.
+
+The training pipeline predicts one tree at a time (ops/predict.py);
+serving batches the FOREST: one jitted dispatch quantizes raw float rows
+against the model's own thresholds and walks all T trees via a vmapped
+lockstep traversal. See docs/SERVING.md for the array layout, the
+power-of-two bucket policy, and the queue semantics.
+
+>>> from lightgbm_tpu.serve import PredictServer, StackedForest
+>>> forest = StackedForest.from_gbdt(booster)     # or a Booster directly
+>>> server = PredictServer(forest, max_batch=256)
+>>> server.predict(row)                           # coalesced micro-batch
+"""
+from .cache import BucketedPredictor  # noqa: F401
+from .forest import StackedForest, round_down_f32  # noqa: F401
+from .server import ModelRegistry, PredictServer  # noqa: F401
+
+__all__ = ["StackedForest", "BucketedPredictor", "ModelRegistry",
+           "PredictServer", "round_down_f32"]

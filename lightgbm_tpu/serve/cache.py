@@ -1,0 +1,108 @@
+"""Shape-bucketed compile cache for stacked-forest prediction.
+
+XLA compiles one executable per input shape, and serving traffic arrives
+at every batch size there is — left alone, that is a compile per distinct
+row count (the retrace pathology obs/compile.py exists to surface).
+The cache quantizes incoming batches onto power-of-two row buckets
+(``min_bucket`` .. ``max_bucket``), pads up, dispatches, and slices the
+pad back off, so the whole serving lifetime of a model version compiles
+at most ``log2(max_bucket / min_bucket) + 1`` variants per output kind.
+
+Entries are keyed ``(model_version, bucket, output_kind)``. The jitted
+executables themselves live in jax's jit cache (keyed by array shapes,
+so two model versions with equal packed shapes share compilations);
+this layer tracks the bucket policy: which keys exist, hit/compile
+counts (``serve/bucket_hit`` / ``serve/bucket_compile`` counters and the
+``serve/compile_cache_size`` gauge), while retraces stay attributable
+per jit function through obs/compile.py (``serve.stacked_leaves`` /
+``serve.stacked_raw``).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..obs.registry import registry as obs
+from ..utils import next_pow2
+from .forest import StackedForest
+
+_KINDS = ("value", "raw", "leaf", "raw_device")
+
+
+class BucketedPredictor:
+    """Pads batches to power-of-two row buckets around a StackedForest;
+    ``swap`` replaces the forest for hot model upgrades (the bucket
+    policy and stats survive the swap)."""
+
+    def __init__(self, forest: StackedForest, model_version=0,
+                 min_bucket: int = 16, max_bucket: int = 1 << 16,
+                 output_kind: str = "value"):
+        if output_kind not in _KINDS:
+            raise ValueError("output_kind must be one of %s" % (_KINDS,))
+        self.forest = forest
+        self.model_version = model_version
+        self.min_bucket = max(int(min_bucket), 1)
+        self.max_bucket = max(int(max_bucket), self.min_bucket)
+        self.output_kind = output_kind
+        # (model_version, bucket, kind) -> dispatch count
+        self.entries: Dict[Tuple, int] = {}
+
+    def swap(self, forest: StackedForest, model_version) -> None:
+        self.forest = forest
+        self.model_version = model_version
+        # drop the replaced version's keys: a hot-swapping server must
+        # not grow `entries` (and the cache-size gauge) without bound
+        self.entries = {k: v for k, v in self.entries.items()
+                        if k[0] == model_version}
+        obs.gauge("serve/compile_cache_size", len(self.entries))
+
+    def bucket_for(self, n_rows: int) -> int:
+        return min(next_pow2(max(n_rows, self.min_bucket)),
+                   self.max_bucket)
+
+    # ------------------------------------------------------------------
+    def _dispatch(self, kind: str, X: np.ndarray):
+        if kind == "value":
+            return self.forest.predict(X)
+        if kind == "raw":
+            return self.forest.predict_raw(X)
+        if kind == "leaf":
+            return self.forest.leaves(X)
+        return np.asarray(self.forest.predict_raw_device(X))
+
+    def predict(self, X, output_kind: Optional[str] = None) -> np.ndarray:
+        """Predict with bucket padding; batches larger than
+        ``max_bucket`` stream through in max-bucket chunks."""
+        kind = output_kind or self.output_kind
+        if kind not in _KINDS:
+            raise ValueError("output_kind must be one of %s" % (_KINDS,))
+        X = np.asarray(X)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        n = X.shape[0]
+        outs = []
+        for lo in range(0, max(n, 1), self.max_bucket):
+            chunk = X[lo:lo + self.max_bucket]
+            m = chunk.shape[0]
+            bucket = self.bucket_for(m)
+            if m < bucket:
+                pad = np.zeros((bucket - m, X.shape[1]), dtype=X.dtype)
+                chunk = np.concatenate([chunk, pad], axis=0)
+            key = (self.model_version, bucket, kind)
+            if key not in self.entries:
+                self.entries[key] = 0
+                obs.inc("serve/bucket_compile")
+                obs.gauge("serve/compile_cache_size", len(self.entries))
+            else:
+                obs.inc("serve/bucket_hit")
+            self.entries[key] += 1
+            outs.append(self._dispatch(kind, chunk)[:m])
+        return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+
+    # ------------------------------------------------------------------
+    @property
+    def stats(self) -> Dict:
+        return {"entries": dict(self.entries),
+                "hits": obs.count("serve/bucket_hit"),
+                "compiles": obs.count("serve/bucket_compile")}

@@ -1,0 +1,270 @@
+"""StackedForest: the whole forest as one device dispatch.
+
+Training-side device prediction (ops/predict.py ``DeviceTree``) walks one
+tree at a time over dataset-binned rows — fine for per-iteration valid
+scoring, wrong shape for serving: T trees mean T dispatches and the rows
+arrive as raw floats, not bins. This module packs ALL T trees' flat node
+arrays into single ``[T, NI_max]`` arrays so a single jitted program
+quantizes raw rows and walks the entire forest via a vmapped lockstep
+traversal (reference analogue: the CUDA build's whole-model
+``AddPredictionToScoreKernel``; see also arXiv:1806.11248 / 2011.02022 —
+inference throughput comes from batching the forest, not the tree).
+
+Quantization is derived from the model itself: every numeric node's real
+threshold is (by construction) one of the feature's BinMapper
+``bin_upper_bound`` values, so the per-feature sorted unique threshold
+set IS the model's bin grid. Thresholds are stored as the largest f32
+<= t ("round-down f32"), which makes every device decision EXACT for
+f32-representable inputs:
+
+    v <= t  (host, f64)  ⟺  v <= rd32(t)  (device, f32)
+
+because rd32(t) is the largest f32 not above t and v is itself an f32.
+``bin(v) = #{thresholds < v}`` then reduces each node decision to an
+integer compare ``bin <= rank(threshold)``, and NaN / zero-as-missing
+semantics are folded into sentinel bins during quantization (matching
+``models/tree.py _decide`` per-node semantics; per-feature missing types
+are validated to be consistent — a model that mixes them on one feature
+is rejected and served by the host path instead).
+
+``predict`` / ``predict_raw`` keep the host contract bit-for-bit: the
+device computes LEAF IDS only, and leaf values accumulate on host in
+f64 in the same per-tree order as ``GBDT.predict_raw``. The f32
+device-side sum (``predict_raw_device``) is the throughput path for
+serving and bench.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.binning import MissingType, kZeroThreshold
+from ..models.tree import Tree, kCategoricalMask, kDefaultLeftMask
+from ..ops.predict import (QuantizerTables, StackedNodes,
+                           stacked_forest_leaves, stacked_forest_raw)
+from ..utils import next_pow2
+
+
+def round_down_f32(x) -> np.ndarray:
+    """Largest float32 <= x (elementwise). The quantizer's exactness
+    hinges on this rounding direction — see module docstring."""
+    x = np.asarray(x, dtype=np.float64)
+    with np.errstate(over="ignore"):  # |x| > f32 max rounds to ±inf,
+        x32 = x.astype(np.float32)    # then steps down to ±f32 max
+        too_big = x32.astype(np.float64) > x
+        return np.where(too_big,
+                        np.nextafter(x32, np.float32(-np.inf)),
+                        x32).astype(np.float32)
+
+
+_KIND_NONE, _KIND_NUM, _KIND_CAT = 0, 1, 2
+
+
+class StackedForest:
+    """Immutable packed forest + quantizer tables (device-resident)."""
+
+    def __init__(self, models: List[Tree], num_tree_per_iteration: int = 1,
+                 num_features: Optional[int] = None, objective=None,
+                 average_output: bool = False):
+        models = list(models)
+        if not models:
+            raise ValueError("StackedForest needs at least one tree")
+        if any(t.is_linear for t in models):
+            raise ValueError("linear-leaf trees predict from raw features "
+                             "on host; StackedForest cannot serve them")
+        K = max(int(num_tree_per_iteration), 1)
+        if len(models) % K != 0:
+            raise ValueError("len(models)=%d is not a multiple of "
+                             "num_tree_per_iteration=%d" % (len(models), K))
+        if num_features is None:
+            num_features = 1 + max(
+                (int(t.split_feature[:t.num_internal].max())
+                 for t in models if t.num_internal > 0), default=0)
+        F = max(int(num_features), 1)
+        self.num_trees = len(models)
+        self.num_classes = K
+        self.num_features = F
+        self.objective = objective
+        self.average_output = bool(average_output)
+
+        # --- per-feature scan: kind, missing type, threshold set --------
+        kind = np.zeros(F, dtype=np.int8)
+        missing = np.full(F, -1, dtype=np.int8)
+        thresholds: List[List[float]] = [[] for _ in range(F)]
+        cat_nodes: List[tuple] = []  # (tree_idx, node, cat_idx)
+        for ti, tree in enumerate(models):
+            dt = tree.decision_type
+            for node in range(tree.num_internal):
+                f = int(tree.split_feature[node])
+                if f >= F:
+                    raise ValueError("node feature %d out of range (%d)"
+                                     % (f, F))
+                bits = int(dt[node])
+                want = _KIND_CAT if bits & kCategoricalMask else _KIND_NUM
+                if kind[f] not in (_KIND_NONE, want):
+                    raise ValueError(
+                        "feature %d has both numeric and categorical "
+                        "splits; cannot build a stacked quantizer" % f)
+                kind[f] = want
+                if want == _KIND_CAT:
+                    cat_nodes.append((ti, node,
+                                      int(tree.threshold_in_bin[node])))
+                    continue
+                m = (bits >> 2) & 3
+                m = min(m, MissingType.NAN)
+                if missing[f] not in (-1, m):
+                    raise ValueError(
+                        "feature %d mixes missing types across nodes; "
+                        "cannot quantize once per row" % f)
+                missing[f] = m
+                t = float(tree.threshold[node])
+                if not np.isnan(t):
+                    thresholds[f].append(t)
+
+        # --- quantizer tables ------------------------------------------
+        thr32 = [np.unique(round_down_f32(np.asarray(ts)))
+                 if ts else np.zeros(0, dtype=np.float32)
+                 for ts in thresholds]
+        M = max(1, max((len(u) for u in thr32), default=1))
+        thr = np.full((F, M), np.inf, dtype=np.float32)
+        for f, u in enumerate(thr32):
+            thr[f, :len(u)] = u
+        vmax = max((models[ti].cat_value_words(ci) * 32 - 1
+                    for ti, _, ci in cat_nodes), default=-1)
+        vmax = max(vmax, 0)
+        # shared LUT over category values; row 0 (non-cat nodes) and the
+        # last column (out-of-range/NaN values) are all-False == go right
+        cat_lut = np.zeros((len(cat_nodes) + 1, vmax + 2), dtype=bool)
+        cat_slot_of = {}
+        for slot, (ti, node, ci) in enumerate(cat_nodes, start=1):
+            cat_lut[slot, :vmax + 1] = models[ti].cat_value_mask(ci, vmax)
+            cat_slot_of[(ti, node)] = slot
+
+        # --- stacked node arrays ---------------------------------------
+        T = len(models)
+        NI = next_pow2(max((t.num_internal for t in models), default=1))
+        NL = next_pow2(max(t.num_leaves for t in models))
+        feat = np.zeros((T, NI), dtype=np.int32)
+        tbin = np.full((T, NI), -1, dtype=np.int32)
+        dleft = np.zeros((T, NI), dtype=bool)
+        left = np.full((T, NI), ~0, dtype=np.int32)
+        right = np.full((T, NI), ~0, dtype=np.int32)
+        is_cat = np.zeros((T, NI), dtype=bool)
+        cat_slot = np.zeros((T, NI), dtype=np.int32)
+        leaf_f32 = np.zeros((T, NL), dtype=np.float32)
+        leaf_f64 = np.zeros((T, NL), dtype=np.float64)
+        depth = 0
+        for ti, tree in enumerate(models):
+            ni = tree.num_internal
+            nl = tree.num_leaves
+            leaf_f64[ti, :nl] = tree.leaf_value[:nl]
+            leaf_f32[ti, :nl] = tree.leaf_value[:nl].astype(np.float32)
+            depth = max(depth, tree.structure_depth())
+            if ni == 0:
+                continue  # stump: padded root falls through to leaf 0
+            dt = tree.decision_type[:ni]
+            feat[ti, :ni] = tree.split_feature[:ni]
+            dleft[ti, :ni] = (dt.astype(np.int64) & kDefaultLeftMask) != 0
+            left[ti, :ni] = tree.left_child[:ni]
+            right[ti, :ni] = tree.right_child[:ni]
+            for node in range(ni):
+                slot = cat_slot_of.get((ti, node))
+                if slot is not None:
+                    is_cat[ti, node] = True
+                    cat_slot[ti, node] = slot
+                    continue
+                t = float(tree.threshold[node])
+                if np.isnan(t):
+                    continue  # tbin stays -1: "v <= NaN" is always False
+                f = int(tree.split_feature[node])
+                tbin[ti, node] = int(np.searchsorted(
+                    thr32[f], round_down_f32(t), side="left"))
+
+        self.trips = next_pow2(max(depth, 1))
+        self._leaf_value_host = leaf_f64
+        self._nodes = StackedNodes(
+            feat=jnp.asarray(feat), tbin=jnp.asarray(tbin),
+            default_left=jnp.asarray(dleft), left=jnp.asarray(left),
+            right=jnp.asarray(right), is_cat=jnp.asarray(is_cat),
+            cat_slot=jnp.asarray(cat_slot),
+            leaf_value=jnp.asarray(leaf_f32))
+        self._cat_lut = jnp.asarray(cat_lut)
+        self._qt = QuantizerTables(
+            thresholds=jnp.asarray(thr),
+            is_cat=jnp.asarray(kind == _KIND_CAT),
+            nan_feat=jnp.asarray((kind == _KIND_NUM)
+                                 & (missing == MissingType.NAN)),
+            zero_feat=jnp.asarray((kind == _KIND_NUM)
+                                  & (missing == MissingType.ZERO)),
+            vmax=jnp.asarray(np.int32(vmax)),
+            zero_eps=jnp.asarray(round_down_f32(kZeroThreshold)))
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_gbdt(cls, gbdt, start_iteration: int = 0,
+                  num_iteration: int = -1) -> "StackedForest":
+        """Pack a trained or text-loaded GBDT (same tree slice as
+        ``GBDT.predict_raw``)."""
+        gbdt = getattr(gbdt, "inner", gbdt)  # accept a Booster too
+        models = gbdt._used_models(start_iteration, num_iteration)
+        return cls(models, gbdt.num_tree_per_iteration,
+                   gbdt.max_feature_idx + 1, objective=gbdt.objective,
+                   average_output=gbdt.average_output)
+
+    # ------------------------------------------------------------------
+    def _prep(self, X) -> np.ndarray:
+        X = np.asarray(X)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        if X.shape[1] != self.num_features:
+            raise ValueError(
+                "X has %d features, model expects %d"
+                % (X.shape[1], self.num_features))
+        # the serving contract: rows are interpreted as float32 (the
+        # quantizer is exact for f32-representable values)
+        return np.ascontiguousarray(X, dtype=np.float32)
+
+    def leaves(self, X) -> np.ndarray:
+        """[n, T] leaf index of every row in every tree (one device
+        dispatch for quantize + forest walk)."""
+        Xd = self._prep(X)
+        out = stacked_forest_leaves(Xd, self._qt, self._nodes,
+                                    self._cat_lut, self.trips)
+        return np.asarray(out).T
+
+    def predict_raw(self, X) -> np.ndarray:
+        """Raw scores, bit-identical to ``GBDT.predict_raw``: device leaf
+        ids + host f64 accumulation in the same per-tree order."""
+        leaves = self.leaves(X)
+        n = leaves.shape[0]
+        K = self.num_classes
+        out = np.zeros((n, K), dtype=np.float64)
+        lv = self._leaf_value_host
+        for i in range(self.num_trees):
+            out[:, i % K] += lv[i][leaves[:, i]]
+        if self.average_output and self.num_trees:
+            out /= max(self.num_trees // K, 1)
+        return out[:, 0] if K == 1 else out
+
+    def predict(self, X, raw_score: bool = False) -> np.ndarray:
+        """Transformed output, bit-identical to the host
+        ``Booster.predict`` (same objective ``convert_output``)."""
+        raw = self.predict_raw(X)
+        if raw_score or self.objective is None:
+            return raw
+        return self.objective.convert_output(raw)
+
+    def predict_raw_device(self, X) -> jnp.ndarray:
+        """[n, K] f32 raw scores summed ON DEVICE — the serving
+        throughput path (f32 accumulation: fast, not bit-identical to
+        the host's f64 sum)."""
+        Xd = self._prep(X)
+        out = stacked_forest_raw(Xd, self._qt, self._nodes, self._cat_lut,
+                                 self.trips, self.num_classes)
+        if self.average_output and self.num_trees:
+            # RF-style averaging, same factor as the host predict_raw
+            out = out / np.float32(
+                max(self.num_trees // self.num_classes, 1))
+        return out

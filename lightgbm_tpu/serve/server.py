@@ -1,0 +1,288 @@
+"""Model registry + micro-batching predict server.
+
+``ModelRegistry`` holds named, versioned StackedForests and supports hot
+swap: ``load`` packs a new version (from a live Booster/GBDT or a
+LightGBM-v3 model text via models/tree.py parsing) and atomically
+publishes it; every swap emits a ``model_swap`` event. In-flight
+dispatches finish on the version they started with.
+
+``PredictServer`` coalesces concurrent requests into device batches: a
+worker thread drains the queue, waits up to ``max_wait_ms`` from the
+first queued request for more rows (up to ``max_batch``), and runs ONE
+bucketed dispatch for the whole batch — N concurrent single-row
+requests cost ceil(N / max_batch) dispatches, not N. Telemetry per
+dispatch: a ``predict_batch`` event, the ``serve/queue_depth`` gauge,
+and a ``serve/latency_ms`` histogram (p50/p99 via
+``registry.percentile``).
+
+No TPU? The server keeps serving on whatever backend jax resolved and
+emits the existing ``backend_fallback`` health event (never silent —
+the round-5 lesson), since the stacked predictor lowers to plain XLA
+gathers that run anywhere.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..obs import events as obs_events
+from ..obs import health as obs_health
+from ..obs.registry import registry as obs
+from ..utils import log
+from ..utils import next_pow2
+from .cache import BucketedPredictor
+from .forest import StackedForest
+
+
+class ModelRegistry:
+    """Named, versioned StackedForests with hot swap."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models: Dict[str, tuple] = {}  # name -> (version, forest)
+
+    def load(self, name: str = "default", booster=None,
+             model_str: Optional[str] = None,
+             model_file: Optional[str] = None, start_iteration: int = 0,
+             num_iteration: int = -1) -> int:
+        """Pack and publish a model version; returns the version id.
+        Sources (one of): a live Booster/GBDT, a v3 model text string,
+        or a model file path."""
+        if model_file is not None:
+            with open(model_file) as f:
+                model_str = f.read()
+            source = "file"
+        elif model_str is not None:
+            source = "string"
+        elif booster is not None:
+            source = "booster"
+        else:
+            raise ValueError("load needs booster=, model_str= or "
+                             "model_file=")
+        if model_str is not None:
+            from ..basic import Booster
+            booster = Booster(model_str=model_str)
+        forest = StackedForest.from_gbdt(booster, start_iteration,
+                                         num_iteration)
+        return self.publish(name, forest, source=source)
+
+    def publish(self, name: str, forest: StackedForest,
+                source: str = "direct") -> int:
+        with self._lock:
+            version = (self._models[name][0] + 1
+                       if name in self._models else 1)
+            self._models[name] = (version, forest)
+            obs.gauge("serve/models", len(self._models))
+        log.info("serve: published model %r v%d (%d trees, %d features)"
+                 % (name, version, forest.num_trees, forest.num_features))
+        obs_events.emit("model_swap", name=name, version=version,
+                        num_trees=forest.num_trees,
+                        num_features=forest.num_features,
+                        num_classes=forest.num_classes, source=source)
+        obs_events.flush()
+        return version
+
+    def get(self, name: str = "default"):
+        """(version, forest) of the current published version."""
+        with self._lock:
+            if name not in self._models:
+                raise KeyError("no model published under %r" % name)
+            return self._models[name]
+
+    def names(self):
+        with self._lock:
+            return sorted(self._models)
+
+
+class _Request:
+    __slots__ = ("x", "rows", "single", "future", "t_submit")
+
+    def __init__(self, x: np.ndarray, single: bool):
+        self.x = x
+        self.rows = x.shape[0]
+        self.single = single
+        self.future: Future = Future()
+        self.t_submit = time.perf_counter()
+
+
+class PredictServer:
+    """Thread-safe micro-batching front end over a ModelRegistry entry.
+
+    ``submit`` enqueues and returns a Future; the worker coalesces up to
+    ``max_batch`` rows (waiting at most ``max_wait_ms`` after the first
+    pending request) into one bucketed dispatch. Start with
+    ``autostart=False`` to enqueue before serving (deterministic
+    batching — what the coalescing test uses)."""
+
+    def __init__(self, model, name: str = "default", max_batch: int = 256,
+                 max_wait_ms: float = 2.0, output_kind: str = "value",
+                 min_bucket: int = 16, require_backend: Optional[str] = None,
+                 autostart: bool = True):
+        if isinstance(model, ModelRegistry):
+            self.registry = model
+        else:
+            self.registry = ModelRegistry()
+            if isinstance(model, StackedForest):
+                self.registry.publish(name, model)
+            else:  # Booster / GBDT
+                self.registry.load(name, booster=model)
+        self.name = name
+        self.max_batch = max(int(max_batch), 1)
+        self.max_wait = max(float(max_wait_ms), 0.0) / 1e3
+        version, forest = self.registry.get(name)
+        self.predictor = BucketedPredictor(
+            forest, model_version=version, min_bucket=min_bucket,
+            max_bucket=max(next_pow2(self.max_batch), min_bucket),
+            output_kind=output_kind)
+        if require_backend is not None:
+            import jax
+            actual = jax.default_backend()
+            if actual != require_backend:
+                obs_health.record_backend_fallback(
+                    "serve: %s backend unavailable, serving on %s"
+                    % (require_backend, actual),
+                    requested=require_backend, actual=actual)
+        self._queue: deque = deque()
+        self._pending_rows = 0
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {"dispatches": 0, "requests": 0, "rows": 0}
+        if autostart:
+            self.start()
+
+    # ------------------------------------------------------------------
+    def start(self) -> "PredictServer":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._run, name="lightgbm-tpu-serve", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop accepting requests; the worker drains what is already
+        queued, then exits."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+
+    # ------------------------------------------------------------------
+    def submit(self, x) -> Future:
+        """Enqueue one request (a [F] row or an [m, F] block); returns a
+        Future resolving to the prediction for exactly those rows."""
+        x = np.asarray(x, dtype=np.float32)
+        single = x.ndim == 1
+        if x.ndim not in (1, 2):
+            raise ValueError("submit takes a [F] row or an [m, F] block")
+        # validate now, not at dispatch: a malformed request must fail
+        # ITSELF, never the batch it would have coalesced with
+        n_feat = self.registry.get(self.name)[1].num_features
+        if x.shape[-1] != n_feat:
+            raise ValueError("request has %d features, model %r expects "
+                             "%d" % (x.shape[-1], self.name, n_feat))
+        req = _Request(x.reshape(1, -1) if single else x, single)
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("PredictServer is stopped")
+            self._queue.append(req)
+            self._pending_rows += req.rows
+            obs.gauge("serve/queue_depth", self._pending_rows)
+            self._cond.notify()
+        return req.future
+
+    def predict(self, x, timeout: Optional[float] = None):
+        """Synchronous convenience wrapper around ``submit``."""
+        return self.submit(x).result(timeout=timeout)
+
+    # ------------------------------------------------------------------
+    def _take_batch(self):
+        """Collect up to max_batch rows, waiting up to max_wait after
+        the first pending request. Returns [] only at shutdown."""
+        with self._cond:
+            while not self._queue:
+                if self._stop:
+                    return []
+                # no timeout: submit() and stop() both notify, so an
+                # idle server sleeps instead of polling
+                self._cond.wait()
+            deadline = time.perf_counter() + self.max_wait
+            batch = []
+            rows = 0
+            while True:
+                while self._queue and rows < self.max_batch:
+                    nxt = self._queue[0]
+                    if batch and rows + nxt.rows > self.max_batch:
+                        break  # oversized next request: next dispatch
+                    batch.append(self._queue.popleft())
+                    rows += nxt.rows
+                if rows >= self.max_batch or self._stop:
+                    break
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=remaining)
+            self._pending_rows -= rows
+            obs.gauge("serve/queue_depth", self._pending_rows)
+            return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                if self._stop and not self._queue:
+                    return
+                continue
+            self._dispatch(batch)
+
+    def _dispatch(self, batch) -> None:
+        # claim every future first: a client-cancelled Future must drop
+        # out here — set_result on it would raise InvalidStateError and
+        # kill the worker (then every later submit hangs forever)
+        batch = [r for r in batch
+                 if r.future.set_running_or_notify_cancel()]
+        if not batch:
+            return
+        rows = sum(r.rows for r in batch)
+        try:
+            # hot swap: pick up the latest published version between
+            # dispatches (never mid-batch)
+            version, forest = self.registry.get(self.name)
+            if version != self.predictor.model_version:
+                self.predictor.swap(forest, version)
+            X = (batch[0].x if len(batch) == 1
+                 else np.concatenate([r.x for r in batch], axis=0))
+            t0 = time.perf_counter()
+            y = self.predictor.predict(X)
+            dt = time.perf_counter() - t0
+        except Exception as e:  # noqa: BLE001 — a bad batch must not
+            for r in batch:     # kill the worker; fail its futures
+                r.future.set_exception(e)
+            return
+        now = time.perf_counter()
+        lo = 0
+        for r in batch:
+            part = y[lo:lo + r.rows]
+            lo += r.rows
+            obs.observe("serve/latency_ms", (now - r.t_submit) * 1e3)
+            r.future.set_result(part[0] if r.single else part)
+        self.stats["dispatches"] += 1
+        self.stats["requests"] += len(batch)
+        self.stats["rows"] += rows
+        obs_events.emit(
+            "predict_batch", model=self.name,
+            version=self.predictor.model_version, n_requests=len(batch),
+            rows=rows, bucket=self.predictor.bucket_for(rows),
+            seconds=round(dt, 6))
+
+    # ------------------------------------------------------------------
+    def latency_percentiles(self) -> Dict[str, float]:
+        return {"p50": obs.percentile("serve/latency_ms", 50.0),
+                "p99": obs.percentile("serve/latency_ms", 99.0)}

@@ -96,6 +96,7 @@ def fatal(msg: str, *args) -> None:
     try:
         from ..obs import events as _events
         _events.emit("log_fatal", message=msg)
+        _events.flush()  # buffered sink: the crash evidence must land
     except Exception:
         pass
     raise LightGBMError(msg)

@@ -1,0 +1,55 @@
+"""Worker for the distributed-binning layout regression test: each rank
+holds an UNEQUAL row shard (so the allgather pad/trim path runs), builds
+the distributed dataset, and dumps its bin-mapper layout + local bins
+for the parent to compare against the pinned single-process replay.
+"""
+import sys
+
+import numpy as np
+
+N_ROWS, N_FEATURES, DATA_SEED = 600, 5, 7
+SPLIT = 500  # rank 0: 500 rows, rank 1: 100 → unequal sample takes
+
+
+def make_data():
+    rng = np.random.RandomState(DATA_SEED)
+    X = rng.randn(N_ROWS, N_FEATURES)
+    X[:, 3] = np.round(X[:, 3] * 2.0)  # ties: boundary-sensitive feature
+    X[rng.rand(N_ROWS) < 0.2, 1] = 0.0
+    return X
+
+
+def worker_params():
+    return {"bin_construct_sample_cnt": 256, "max_bin": 16,
+            "verbosity": -1}
+
+
+def shard(X, rank):
+    return X[:SPLIT] if rank == 0 else X[SPLIT:]
+
+
+def main() -> None:
+    rank, nproc, port, out = (int(sys.argv[1]), int(sys.argv[2]),
+                              sys.argv[3], sys.argv[4])
+    import jax
+    jax.distributed.initialize("127.0.0.1:%s" % port, nproc, rank)
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.parallel.distributed import distributed_binned_dataset
+
+    X = make_data()
+    cfg = Config.from_params(worker_params())
+    ds = distributed_binned_dataset(shard(X, rank), cfg)
+    bounds = [np.asarray(m.bin_upper_bound, dtype=np.float64)
+              for m in ds.bin_mappers]
+    np.savez(out,
+             sizes=np.asarray([len(b) for b in bounds], dtype=np.int64),
+             bounds=np.concatenate(bounds) if bounds else np.zeros(0),
+             missing=np.asarray([m.missing_type for m in ds.bin_mappers],
+                                dtype=np.int64),
+             used=np.asarray(ds.used_feature_map, dtype=np.int64),
+             bins=ds.bins.astype(np.int64))
+
+
+if __name__ == "__main__":
+    main()

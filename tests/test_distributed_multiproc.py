@@ -223,3 +223,83 @@ def test_two_process_capabilities_match_single_process(tmp_path, mode):
     np.testing.assert_array_equal(
         w[0]["threshold_in_bin"],
         tree.threshold_in_bin[:tree.num_internal])
+
+
+_BINNING_WORKER = os.path.join(os.path.dirname(__file__), "distributed",
+                               "_binning_worker.py")
+
+
+@pytest.mark.slow
+def test_two_process_distributed_binning_layout(tmp_path):
+    """Regression for the PR-1 allgather shape fix: pins the gathered
+    sample LAYOUT of multi-process ``distributed_binned_dataset`` —
+    per-rank sorted sample rows, padded to the max take, trimmed by the
+    gathered count vector, concatenated in RANK order — by replaying
+    exactly that construction single-process and demanding bit-equal bin
+    mappers on every rank. The shards are unequal (500/100 rows) so the
+    pad/trim path actually runs."""
+    from tests.distributed import _binning_worker as bw
+
+    nproc = 2
+    port = _free_port()
+    outs = [str(tmp_path / ("b%d.npz" % r)) for r in range(nproc)]
+    procs = [subprocess.Popen(
+        [sys.executable, _BINNING_WORKER, str(r), str(nproc), str(port),
+         outs[r]],
+        env=_worker_env(2), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+        for r in range(nproc)]
+    logs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        logs.append(out)
+    for r, p in enumerate(procs):
+        assert p.returncode == 0, "worker %d failed:\n%s" % (r, logs[r])
+    w = [np.load(o) for o in outs]
+
+    # every rank built IDENTICAL mappers (same gathered sample seen)
+    np.testing.assert_array_equal(w[0]["sizes"], w[1]["sizes"])
+    np.testing.assert_array_equal(w[0]["bounds"], w[1]["bounds"])
+    np.testing.assert_array_equal(w[0]["missing"], w[1]["missing"])
+    np.testing.assert_array_equal(w[0]["used"], w[1]["used"])
+
+    # replay the pinned layout single-process: per-rank sorted sample,
+    # concatenated rank-major (this is the contract the allgather must
+    # reproduce bit-for-bit, f64 included)
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    X = bw.make_data()
+    cfg = Config.from_params(bw.worker_params())
+    per_proc = max(1, cfg.bin_construct_sample_cnt // nproc)
+    parts = []
+    for rank in range(nproc):
+        local = bw.shard(X, rank)
+        take = min(per_proc, local.shape[0])
+        rng = np.random.RandomState(cfg.data_random_seed + rank)
+        idx = (np.sort(rng.choice(local.shape[0], take, replace=False))
+               if take < local.shape[0] else np.arange(local.shape[0]))
+        parts.append(local[idx])
+    assert len(parts[0]) != len(parts[1]), \
+        "test must exercise the unequal-take padding path"
+    full_sample = np.concatenate(parts, axis=0)
+    cfg2 = Config.from_params(dict(
+        cfg.raw_params, bin_construct_sample_cnt=len(full_sample)))
+    template = BinnedDataset.from_matrix(full_sample, cfg2)
+    exp_bounds = np.concatenate(
+        [np.asarray(m.bin_upper_bound) for m in template.bin_mappers])
+    np.testing.assert_array_equal(w[0]["bounds"], exp_bounds)
+    np.testing.assert_array_equal(
+        w[0]["sizes"],
+        [len(m.bin_upper_bound) for m in template.bin_mappers])
+    np.testing.assert_array_equal(w[0]["used"], template.used_feature_map)
+
+    # local rows bin identically to reference-aligned binning
+    for rank in range(nproc):
+        expected = BinnedDataset.from_matrix(
+            bw.shard(X, rank), cfg, reference=template).bins
+        np.testing.assert_array_equal(w[rank]["bins"],
+                                      expected.astype(np.int64))

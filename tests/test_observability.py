@@ -362,11 +362,14 @@ def test_bench_json_has_backend_and_phases_keys():
         os.path.abspath(__file__))))
     import bench
     env_keys = ("BENCH_ROWS", "BENCH_ITERS", "BENCH_WARMUP",
-                "BENCH_TREE_BATCH", "BENCH_TIME_BUDGET")
+                "BENCH_TREE_BATCH", "BENCH_TIME_BUDGET",
+                "BENCH_PREDICT_ROWS", "BENCH_PREDICT_DISPATCHES")
     saved = {k: os.environ.get(k) for k in env_keys}
     os.environ.update(BENCH_ROWS="1200", BENCH_ITERS="3",
                       BENCH_WARMUP="1", BENCH_TREE_BATCH="1",
-                      BENCH_TIME_BUDGET="120")
+                      BENCH_TIME_BUDGET="120",
+                      BENCH_PREDICT_ROWS="8192",
+                      BENCH_PREDICT_DISPATCHES="2")
     try:
         result = bench.run_bench()
     finally:
@@ -379,5 +382,142 @@ def test_bench_json_has_backend_and_phases_keys():
     assert result["backend_fallback"] is None
     assert isinstance(result["phases"], dict) and result["phases"]
     assert "tree::root_histogram" in result["phases"]
+    # the serving predict stage is a first-class key (ISSUE 2)
+    assert result["predict_rows_per_sec"] > 0.0
+    assert result["predict_rows"] >= 1
     # the JSON line the driver captures must stay serializable
     json.dumps(result)
+
+
+# ----------------------------------------------------------------------
+# buffered JSONL writer (ISSUE 2 satellite): ordering and content are
+# exactly those of the old per-emit open/append/close writer
+# ----------------------------------------------------------------------
+
+def test_event_buffer_defers_writes_until_flush(tmp_path):
+    path = str(tmp_path / "buffered.jsonl")
+    events.configure(path)
+    for i in range(5):  # well under the default 64-line buffer
+        events.emit("buffered", seq=i)
+    assert not os.path.exists(path) or os.path.getsize(path) == 0, \
+        "emits below the buffer limit must not touch the file"
+    events.flush()
+    with open(path) as f:
+        recs = [json.loads(line) for line in f]
+    assert [r["seq"] for r in recs] == list(range(5))
+    assert all(r["event"] == "buffered" and "ts" in r for r in recs)
+    events.configure(None)
+
+
+def test_event_buffer_overflow_flushes_in_order(tmp_path, monkeypatch):
+    path = str(tmp_path / "overflow.jsonl")
+    monkeypatch.setenv("LIGHTGBM_TPU_EVENT_BUFFER", "4")
+    events.configure(path)
+    for i in range(10):
+        events.emit("ovf", seq=i, arr=np.arange(2), f=np.float32(i))
+    # 10 emits with a 4-line buffer: two overflow flushes landed 8 lines
+    with open(path) as f:
+        on_disk = [json.loads(line) for line in f]
+    assert [r["seq"] for r in on_disk] == list(range(8))
+    events.configure(None)  # flushes the 2-line tail
+    recs = events.read_jsonl(path)
+    assert [r["seq"] for r in recs] == list(range(10))
+    assert recs[3]["arr"] == [0, 1] and recs[3]["f"] == 3.0
+
+
+def test_event_buffer_tracks_sink_path_changes(tmp_path):
+    """Records buffered under path A must land in A even when the sink
+    moved to B before the flush — per-file order is emission order."""
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    events.configure(a)
+    events.emit("one", n=1)
+    events.configure(b)  # flushes A's record
+    events.emit("two", n=2)
+    events.configure(None)
+    assert [r["n"] for r in events.read_jsonl(a)] == [1]
+    assert [r["n"] for r in events.read_jsonl(b)] == [2]
+
+
+def test_event_buffer_flushes_at_exit(tmp_path):
+    """A process that emits fewer events than the buffer limit and
+    exits without calling flush() must still persist them (atexit).
+    events.py is deliberately stdlib-only, so the child loads it
+    standalone — no package/jax import on the single-core CI budget."""
+    path = str(tmp_path / "atexit.jsonl")
+    mod = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "lightgbm_tpu", "obs", "events.py")
+    code = (
+        "import importlib.util\n"
+        "spec = importlib.util.spec_from_file_location('ev', %r)\n"
+        "ev = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(ev)\n"
+        "ev.configure(%r)\n"
+        "ev.emit('tail', n=1)\n" % (mod, path)
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    recs = events.read_jsonl(path)
+    assert [r["event"] for r in recs] == ["tail"]
+
+
+# ----------------------------------------------------------------------
+# histograms (serving latency telemetry lives here)
+# ----------------------------------------------------------------------
+
+def test_registry_histogram_percentiles_and_snapshot():
+    r = MetricsRegistry()
+    for v in range(1, 101):
+        r.observe("lat", float(v))
+    assert r.percentile("lat", 50) == pytest.approx(50.5)
+    assert r.percentile("lat", 99) == pytest.approx(99.01)
+    assert r.percentile("missing", 50) == 0.0
+    snap = r.snapshot()
+    assert snap["hists"]["lat"]["count"] == 100
+    assert snap["hists"]["lat"]["p99"] >= snap["hists"]["lat"]["p50"]
+    r.reset()
+    assert r.percentile("lat", 50) == 0.0
+
+
+def test_registry_histogram_reservoir_is_bounded():
+    from lightgbm_tpu.obs.registry import kHistCap
+    r = MetricsRegistry()
+    for v in range(kHistCap + 500):
+        r.observe("big", float(v))
+    assert len(r.hist_values["big"]) == kHistCap
+    assert r.hist_counts["big"] == kHistCap + 500
+    # old samples aged out: the reservoir holds the newest values
+    assert min(r.hist_values["big"]) == 500.0
+
+
+# ----------------------------------------------------------------------
+# unified eval instrumentation (ISSUE 2 satellite): one eval pass ==
+# one gbdt::eval_metrics scope == one `eval` event, on BOTH paths
+# ----------------------------------------------------------------------
+
+def test_eval_emits_exactly_one_scope_and_event_per_pass(tmp_path):
+    path = str(tmp_path / "eval_unify.jsonl")
+    registry.reset()
+    registry.enable()
+    events.configure(path)
+    X, y = _small_problem()
+    Xv, yv = _small_problem(seed=1)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+         "min_data_in_leaf": 5, "metric": "binary_logloss"},
+        ds, num_boost_round=3,
+        valid_sets=[lgb.Dataset(Xv, label=yv, reference=ds)])
+    # the CLI-style path shares the same instrumentation point
+    bst.inner.eval_metrics()
+    bst.eval_valid()
+    events.configure(None)
+    registry.disable()
+    n_events = len([r for r in events.read_jsonl(path)
+                    if r["event"] == "eval"])
+    n_scopes = registry.timer.counts["gbdt::eval_metrics"]
+    assert n_events >= 5  # 3 training-loop passes + the 2 explicit ones
+    assert n_scopes == n_events, (
+        "eval double-instrumented: %d scopes vs %d events"
+        % (n_scopes, n_events))
