@@ -199,10 +199,14 @@ def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
     from lightgbm_tpu.io.dataset import BinnedDataset
     from lightgbm_tpu.boosting import create_boosting
     from lightgbm_tpu.obs import health as obs_health
+    from lightgbm_tpu.obs import trace as obs_trace
     from lightgbm_tpu.obs.registry import registry as obs_registry
 
     # stage timing feeds the machine-readable ``phases`` dict of the
-    # result JSON (no TIMETAG env needed for the bench)
+    # result JSON — now with per-stage p50/p99 latency columns, so the
+    # artifact records distributions, not just means (no TIMETAG env
+    # needed for the bench). Setting LIGHTGBM_TPU_TRACE additionally
+    # exports the whole run as a Perfetto trace.
     obs_registry.enable()
     obs_health.record_backend(platform, source="bench")
     if fallback:
@@ -304,6 +308,11 @@ def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
     kernel = ("pallas" if _use_pallas() else
               "scatter" if jax.default_backend() == "cpu" else "einsum")
 
+    # flush the span trace (if LIGHTGBM_TPU_TRACE is set) before the
+    # result line, so a driver that kills the process right after
+    # reading stdout still finds a complete trace file
+    obs_trace.flush()
+
     rows_note = ("" if n_rows == HIGGS_ROWS
                  else " [NOT full Higgs scale; vs_baseline reported 0]")
     fb_note = " [CPU FALLBACK: %s]" % fallback if fallback else ""
@@ -324,7 +333,10 @@ def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
         # in the unit string again
         "backend": platform,
         "backend_fallback": fallback or None,
+        # per-stage totals AND latency distributions (p50_ms/p99_ms from
+        # the registry's bounded per-call reservoirs)
         "phases": obs_registry.phases(),
+        "trace": obs_trace.sink_path(),
         # serving throughput (rows/sec through serve.StackedForest's
         # whole-forest dispatch at BENCH_PREDICT_ROWS scale)
         "predict_rows_per_sec": predict_res["predict_rows_per_sec"],

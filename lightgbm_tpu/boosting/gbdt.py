@@ -25,6 +25,7 @@ import numpy as np
 from ..config import Config
 from ..obs import events as obs_events
 from ..obs import health as obs_health
+from ..obs import trace as obs_trace
 from ..obs.registry import registry as obs
 from ..io.binning import MissingType
 from ..io.dataset import BinnedDataset
@@ -328,6 +329,7 @@ class GBDT:
             if K > 1 and g.ndim == 1:
                 g = g.reshape(K, self.num_data).T
                 h = h.reshape(K, self.num_data).T
+            obs.watch_ready("gbdt::gradients", (g, h))
 
         with obs.scope("gbdt::bagging"):
             g, h, bag = self.sample_strategy.bagging(self.iter, g, h)
@@ -411,7 +413,10 @@ class GBDT:
                          seconds: Optional[float] = None) -> None:
         """Per-iteration training event (iter index, wall time, tree
         shape); eval results ride the separate ``eval`` event emitted by
-        eval_metrics (evaluation is metric_freq-gated)."""
+        eval_metrics (evaluation is metric_freq-gated). Also the
+        per-iteration device-memory sampling point (HBM gauges /
+        live-buffer fallback — cheap no-op when telemetry is off)."""
+        obs_trace.sample_iteration(self.iter)
         if not obs_events.enabled():
             return
         if seconds is None:
@@ -548,6 +553,7 @@ class GBDT:
         partition (reference: GBDT::UpdateScore, gbdt.cpp:475)."""
         with obs.scope("gbdt::score_update"):
             self._update_score_inner(tree, leaf_of_row, class_id)
+            obs.watch_ready("gbdt::score_update", self.train_score)
 
     def _update_score_inner(self, tree: Tree, leaf_of_row: jnp.ndarray,
                             class_id: int) -> None:

@@ -12,26 +12,36 @@ at exit under -DUSE_TIMETAG). This package is the TPU-native superset:
 - :mod:`events`    — a JSON-lines event sink (``LIGHTGBM_TPU_EVENT_LOG``
   env var or a programmatic callback mirroring
   ``log.register_log_callback``).
-- :mod:`compile`   — XLA compile/retrace tracking per jitted function.
+- :mod:`compile`   — XLA compile/retrace tracking per jitted function,
+  plus opt-in ``lower().cost_analysis()`` capture (FLOPs / bytes / HLO
+  size on the ``jit_trace`` event).
 - :mod:`health`    — backend selection / fallback events.
+- :mod:`trace`     — span tracing layered onto the scopes and events
+  above, exported as Chrome-trace/Perfetto JSON
+  (``LIGHTGBM_TPU_TRACE=path.json``), with the async readiness drainer
+  that replaces stage fences under ``LIGHTGBM_TPU_TIMETAG=sample``.
 
 Enable stage timing with ``LIGHTGBM_TPU_TIMETAG=1`` (the analogue of
--DUSE_TIMETAG) or ``registry.enable()``; route events to a file with
+-DUSE_TIMETAG; fencing) or ``=sample`` (non-perturbing) or
+``registry.enable()``; route events to a file with
 ``LIGHTGBM_TPU_EVENT_LOG=path`` or ``events.register_event_callback``.
-See docs/OBSERVABILITY.md for the event schema.
+See docs/OBSERVABILITY.md for the event schema and trace format.
 """
 from __future__ import annotations
 
 from . import compile as compile_tracking  # noqa: F401
 from . import events, health  # noqa: F401
 from .registry import MetricsRegistry, StageTimer, registry  # noqa: F401
+from . import trace  # noqa: F401  (installs the span hooks/taps)
 
 scope = registry.scope
 counter = registry.inc
 gauge = registry.gauge
 observe = registry.observe
+watch_ready = registry.watch_ready
 
 __all__ = [
     "MetricsRegistry", "StageTimer", "registry", "events", "health",
-    "compile_tracking", "scope", "counter", "gauge", "observe",
+    "compile_tracking", "trace", "scope", "counter", "gauge", "observe",
+    "watch_ready",
 ]

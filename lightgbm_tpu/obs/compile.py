@@ -1,4 +1,4 @@
-"""XLA compile / retrace tracking.
+"""XLA compile / retrace tracking + compile-cost capture.
 
 ``jax.jit`` re-runs the wrapped Python body once per new static
 signature — every execution of the body IS a trace (and, absent a
@@ -11,25 +11,65 @@ only — XLA lowering + backend compilation happen after the body
 returns, so ``trace_seconds`` is a lower bound / proxy, not the full
 compile cost (which on a remote TPU can be 100x the trace).
 
+:func:`instrument_jit` goes further: it owns the ``jax.jit`` call and,
+when cost capture is on (``LIGHTGBM_TPU_COMPILE_COST=1`` or an active
+span trace), runs ``jit(...).lower(args).cost_analysis()`` for every
+call that actually compiled — the ``jit_trace`` event then carries
+FLOPs, bytes accessed, and the HLO module text size, so the compile
+boundary is costed, not just counted. Compiles are detected by the
+deferred trace records the call itself produced, so steady-state
+(cache-hit) dispatches pay no signature hashing; the explicit
+re-lowering hits jax's shared jaxpr cache and re-runs nothing.
+
 The per-name counters live in the metrics registry under
 ``jit_trace/<name>``; each trace also emits a ``jit_trace`` event.
 The learners legitimately compile several shape variants (the serial
 learner's ~log2(N) gather buckets), so the retrace warning fires only
 past ``LIGHTGBM_TPU_RETRACE_WARN`` traces of one name (default 32;
-0 disables).
+0 disables). The warned-name dedup set resets with ``registry.reset()``
+so repeated runs in one process warn again.
 """
 from __future__ import annotations
 
 import functools
 import os
+import threading
 import time
-from typing import Callable
+from typing import Callable, Dict
 
 from ..utils import log
 from . import events
-from .registry import registry
+from .registry import add_reset_hook, registry
 
 _WARNED = set()
+
+
+def reset_warned() -> None:
+    """Clear the retrace-warning dedup set (also wired into
+    ``registry.reset()`` below)."""
+    _WARNED.clear()
+
+
+add_reset_hook(reset_warned)
+
+# While instrument_jit lowers explicitly for cost analysis, trace
+# records are DEFERRED (stashed on _tls.defer) and replayed once the
+# cost is known — the lowering IS the trace (jax shares the jaxpr cache
+# between .lower() and the call), so counting it twice or before the
+# cost exists would both be wrong. The captured cost_analysis results
+# hand off through _tls.pending (capture and replay happen on the SAME
+# thread; a shared name-keyed dict would let two threads compiling the
+# same fn swap each other's FLOPs).
+_tls = threading.local()
+
+
+def _pending(create: bool = False) -> Dict[str, dict]:
+    pending = getattr(_tls, "pending", None)
+    if pending is None:
+        pending = {}
+        if create:
+            _tls.pending = pending
+    return pending
 
 
 def _warn_threshold() -> int:
@@ -39,17 +79,27 @@ def _warn_threshold() -> int:
         return 32
 
 
-def record_trace(name: str, seconds: float = 0.0) -> int:
+def record_trace(name: str, seconds: float = 0.0,
+                 ended_at: float = None) -> int:
     """Count one trace/compile of ``name``; returns the cumulative
     count. ``seconds`` is the Python-trace wall time (a lower bound on
     the compile cost — see module docstring); it aggregates under the
     ``jit::<name>`` stage regardless of the TIMETAG gate so the retrace
-    evidence survives into BENCH phases."""
+    evidence survives into BENCH phases. ``ended_at`` (unix seconds) is
+    set on deferred replays: the trace actually finished back then, and
+    the span exporter must place the compile span at its true time, not
+    at replay time."""
+    deferred = getattr(_tls, "defer", None)
+    if deferred is not None:
+        deferred.append((name, seconds, time.time()))
+        return registry.count("jit_trace/" + name)
     n = registry.inc("jit_trace/" + name)
-    registry.timer.totals["jit::" + name] += seconds
-    registry.timer.counts["jit::" + name] += 1
+    registry.timer.record("jit::" + name, seconds)
+    extra = _pending(create=False).pop(name, None) or {}
+    if ended_at is not None:
+        extra["ended_ts"] = round(ended_at, 6)
     events.emit("jit_trace", fn=name, count=n,
-                trace_seconds=round(seconds, 6))
+                trace_seconds=round(seconds, 6), **extra)
     thr = _warn_threshold()
     if thr and n == thr + 1 and name not in _WARNED:
         _WARNED.add(name)
@@ -75,6 +125,127 @@ def traced(name: str) -> Callable:
                 record_trace(name, time.perf_counter() - t0)
         return wrapper
     return deco
+
+
+# ----------------------------------------------------------------------
+# compile-cost capture
+# ----------------------------------------------------------------------
+
+# obs.trace resolved once (same rule as registry's jax.profiler):
+# cost_capture_enabled sits on every instrumented dispatch and must not
+# pay import machinery per call
+_trace_mod = None
+
+
+def _get_trace():
+    global _trace_mod
+    if _trace_mod is None:
+        from . import trace
+        _trace_mod = trace
+    return _trace_mod
+
+
+def cost_capture_enabled() -> bool:
+    """On under ``LIGHTGBM_TPU_COMPILE_COST`` (1/0 wins outright) or
+    whenever the span trace is active — traces should cost their
+    compile boundaries."""
+    v = os.environ.get("LIGHTGBM_TPU_COMPILE_COST")
+    if v is not None:
+        return v.strip().lower() not in ("", "0", "false", "off")
+    return _get_trace().active()
+
+
+def _extract_cost(lowered) -> dict:
+    cost: dict = {}
+    try:
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            if "flops" in ca:
+                cost["flops"] = float(ca["flops"])
+            if "bytes accessed" in ca:
+                cost["bytes_accessed"] = float(ca["bytes accessed"])
+    except Exception:
+        pass
+    try:
+        cost["hlo_bytes"] = len(lowered.as_text())
+    except Exception:
+        pass
+    return cost
+
+
+def _capture_cost(name: str, jitted, args, kwargs, deferred) -> None:
+    """A compiling call just happened (``deferred`` holds its stashed
+    trace records): re-lower — jax shares the jaxpr cache between the
+    call and ``.lower()``, so this re-runs nothing — extract FLOPs /
+    bytes accessed / HLO size, and replay the trace records so the
+    ``jit_trace`` event carries the cost of the very compile it
+    counts."""
+    cost: dict = {}
+    prev = getattr(_tls, "defer", None)
+    _tls.defer = []  # swallow any re-trace from an older jax
+    try:
+        cost = _extract_cost(jitted.lower(*args, **kwargs))
+    except Exception:
+        pass
+    finally:
+        _tls.defer = prev
+    if cost:
+        _pending(create=True)[name] = cost
+        if "flops" in cost:
+            registry.gauge("compile/%s/flops" % name, cost["flops"])
+        if "hlo_bytes" in cost:
+            registry.gauge("compile/%s/hlo_bytes" % name,
+                           float(cost["hlo_bytes"]))
+    for deferred_name, seconds, t_end in deferred:
+        record_trace(deferred_name, seconds, ended_at=t_end)
+
+
+def instrument_jit(name: str, fun: Callable, **jit_kwargs) -> Callable:
+    """``jax.jit(traced(name)(fun), **jit_kwargs)`` plus opt-in compile
+    cost capture. Drop-in replacement for the bare composition at every
+    learner/serving jit site: same call signature, same donation /
+    static-argument semantics (positional passthrough).
+
+    Hot-path cost: with capture off, two env lookups per dispatch; with
+    capture on, one thread-local set/restore per dispatch — the
+    expensive lowering runs ONLY on calls that actually compiled (a
+    fresh trace was observed), so steady-state dispatches stay
+    unperturbed even while profiling."""
+    import jax
+    jitted = jax.jit(traced(name)(fun), **jit_kwargs)
+
+    @functools.wraps(fun)
+    def wrapper(*args, **kwargs):
+        if not cost_capture_enabled():
+            return jitted(*args, **kwargs)
+        prev = getattr(_tls, "defer", None)
+        _tls.defer = deferred = []
+        try:
+            out = jitted(*args, **kwargs)
+        except BaseException:
+            _tls.defer = prev
+            # the failing dispatch may be the very compile being
+            # diagnosed: replay its trace records (without the cost
+            # re-lowering) so the jit_trace evidence survives the crash
+            try:
+                for deferred_name, seconds, t_end in deferred:
+                    record_trace(deferred_name, seconds, ended_at=t_end)
+            except Exception:
+                pass
+            raise
+        _tls.defer = prev
+        if deferred:
+            _capture_cost(name, jitted, args, kwargs, deferred)
+        return out
+
+    # AOT passthroughs: callers lower/inspect the jitted object through
+    # the wrapper (tests/test_hlo_size.py lowers the learner programs at
+    # synthetic scale)
+    wrapper.lower = jitted.lower
+    wrapper._jitted = jitted
+    return wrapper
 
 
 def trace_count(name: str) -> int:

@@ -44,6 +44,19 @@ _path_override: Optional[str] = None
 _lock = threading.Lock()
 _buffer: List[Tuple[str, str]] = []  # (sink path at emit time, json line)
 
+# span-trace tap (obs/trace.py): (active_predicate, note_fn). When the
+# trace sink is live every emitted event also lands in the trace as an
+# instant/compile span — the trace layer rides the existing emit call
+# sites without any caller changes.
+_trace_tap: Optional[Tuple[Callable[[], bool],
+                           Callable[[Dict], None]]] = None
+
+
+def install_trace_tap(active_fn: Callable[[], bool],
+                      note_fn: Callable[[Dict], None]) -> None:
+    global _trace_tap
+    _trace_tap = (active_fn, note_fn)
+
 
 def _buffer_limit() -> int:
     try:
@@ -71,8 +84,19 @@ def sink_path() -> Optional[str]:
     return _path_override or os.environ.get(_ENV_VAR) or None
 
 
+def _tap_active() -> bool:
+    tap = _trace_tap
+    if tap is None:
+        return False
+    try:
+        return tap[0]()
+    except Exception:
+        return False
+
+
 def enabled() -> bool:
-    return _callback is not None or sink_path() is not None
+    return (_callback is not None or sink_path() is not None
+            or _tap_active())
 
 
 def _jsonable(v):
@@ -109,6 +133,12 @@ def emit(event: str, **fields) -> Optional[Dict]:
     if cb is not None:
         try:
             cb(rec)
+        except Exception:
+            pass
+    tap = _trace_tap
+    if tap is not None and _tap_active():
+        try:
+            tap[1](rec)
         except Exception:
             pass
     path = sink_path()

@@ -1,0 +1,449 @@
+"""Span tracing with Chrome-trace / Perfetto JSON export.
+
+Layers a span model (trace_id / span_id / parent links, process+host
+tagged) onto the telemetry the pipeline already emits, WITHOUT touching
+any call site:
+
+- every ``registry.timer.scope`` (binning, root_histogram,
+  split_batches, gradients, score_update, predict_batch, ...) becomes a
+  ``ph:"X"`` complete event on the calling thread's lane, parented by
+  the enclosing scope via a thread-local span stack;
+- every ``events.emit`` record becomes an instant event on the same
+  lane (``jit_trace`` events instead become spans on a dedicated
+  compile lane, carrying cost_analysis FLOPs / bytes when captured);
+- the registry's async readiness drainer reports device completion of
+  watched stage outputs as spans on a device-readiness lane;
+- per-iteration device memory gauges land as counter tracks.
+
+Enable with ``LIGHTGBM_TPU_TRACE=/path/to/trace.json`` (or
+:func:`configure`). The file is a standard Chrome-trace JSON object —
+open it at https://ui.perfetto.dev or chrome://tracing. Multi-process
+(dtrain) runs write one file per rank (the rank is folded into the
+path); ``tools/trace_report.py merge`` interleaves them by wall clock
+into one file with per-rank process lanes.
+
+Timestamps are wall-anchored but perf_counter-derived: one (wall, perf)
+origin pair is sampled at import and every event timestamp is
+``origin_wall + (perf_now - origin_perf)``, so intra-process ordering
+is strictly monotone while cross-process merge still lines up on the
+wall clock.
+
+The span buffer is in-memory and bounded (``kMaxEvents``); it is
+written on :func:`flush` (registered atexit), on :func:`configure`,
+and the export rewrites the whole file — partial JSON is never left
+behind.
+"""
+from __future__ import annotations
+
+import atexit
+import itertools
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import events as _events
+from .registry import install_trace_hooks as _install_trace_hooks
+from .registry import registry
+
+_ENV_VAR = "LIGHTGBM_TPU_TRACE"
+
+kMaxEvents = 1 << 18
+
+_lock = threading.Lock()
+_events_buf: List[dict] = []
+_dropped = 0
+_path_override: Optional[str] = None
+_span_seq = itertools.count(1)
+_tls = threading.local()
+
+# wall-anchored monotone clock origin (see module docstring)
+_t0_wall = time.time()
+_t0_perf = time.perf_counter()
+
+_trace_id: Optional[str] = None
+_process_index: Optional[int] = None
+
+# lane (tid) allocation: stable small ints + a thread_name metadata
+# record per lane; special string keys reserve the synthetic lanes
+_lane_ids: Dict[object, int] = {}
+_lane_names: Dict[int, str] = {}
+kReadyLane = "device::ready"
+kCompileLane = "jit::compile"
+
+
+def _now_us() -> float:
+    return (_t0_wall + (time.perf_counter() - _t0_perf)) * 1e6
+
+
+def _perf_to_us(t_perf: float) -> float:
+    return (_t0_wall + (t_perf - _t0_perf)) * 1e6
+
+
+# The env sink is resolved ONCE at import (unlike the event log's
+# per-emit read): active() sits on every stage-scope entry, and the
+# telemetry-off fast path must stay a couple of attribute reads, not an
+# os.environ lookup per scope. Late re-pointing goes through
+# configure().
+_env_path = os.environ.get(_ENV_VAR) or None
+
+
+def sink_path() -> Optional[str]:
+    return _path_override or _env_path
+
+
+def active() -> bool:
+    return _path_override is not None or _env_path is not None
+
+
+def trace_id() -> str:
+    global _trace_id
+    if _trace_id is None:
+        _trace_id = "%d-%x" % (os.getpid(), int(time.time() * 1e6))
+    return _trace_id
+
+
+def process_index() -> int:
+    """The rank used as the Chrome-trace pid (one lane group per rank
+    after merge). Resolved from jax.process_index() when jax is already
+    initialized, else 0; :func:`set_process_index` overrides."""
+    global _process_index
+    if _process_index is None:
+        idx = 0
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            try:
+                idx = int(jax.process_index())
+            except Exception:
+                idx = 0
+        _process_index = idx
+    return _process_index
+
+
+def set_process_index(idx: int) -> None:
+    global _process_index
+    _process_index = int(idx)
+
+
+def rank_path(path: str, rank: int) -> str:
+    """Per-rank trace file name: ``trace.json`` → ``trace.rank1.json``
+    (rank 0 keeps the plain path so single-process usage is unchanged).
+    Idempotent — re-ranking an already-ranked path (a second
+    dtrain.train() in one process) returns it unchanged."""
+    if rank == 0:
+        return path
+    root, ext = os.path.splitext(path)
+    suffix = ".rank%d" % rank
+    if root.endswith(suffix):
+        return path
+    return root + suffix + ext
+
+
+def configure(path: Optional[str],
+              process_index_override: Optional[int] = None,
+              keep_buffer: bool = False) -> None:
+    """Pin the trace sink programmatically (overrides the env var; None
+    falls back to ``LIGHTGBM_TPU_TRACE`` as read at import). By default
+    flushes to the OLD sink and then RESETS the span buffer, so each
+    configured sink holds one self-contained trace.
+
+    ``keep_buffer=True`` re-points WITHOUT touching the old sink:
+    buffered events move to the new path as-is. dtrain uses this to
+    fold the rank into the path — rank>0 must never write (not even a
+    departing flush to) the shared un-ranked file."""
+    global _path_override, _trace_id, _dropped
+    if not keep_buffer:
+        flush()
+    with _lock:
+        _path_override = path
+        if not keep_buffer:
+            _events_buf.clear()
+            _lane_ids.clear()
+            _lane_names.clear()
+            _dropped = 0
+            _trace_id = None
+    if process_index_override is not None:
+        set_process_index(process_index_override)
+
+
+def _lane(key, name: str) -> int:
+    # under _lock: concurrent first-use from the trainer, the readiness
+    # drainer, and serve workers must not hand two threads one tid
+    with _lock:
+        lane = _lane_ids.get(key)
+        if lane is None:
+            lane = len(_lane_ids) + 1
+            _lane_ids[key] = lane
+            _lane_names[lane] = name
+        return lane
+
+
+def _thread_lane() -> int:
+    # keyed by (ident, name), not bare ident: CPython recycles thread
+    # ids, and a recycled id must not inherit a dead thread's lane label
+    t = threading.current_thread()
+    return _lane((t.ident, t.name), t.name)
+
+
+def _push(ev: dict) -> None:
+    global _dropped
+    with _lock:
+        if len(_events_buf) >= kMaxEvents:
+            _dropped += 1
+            return
+        _events_buf.append(ev)
+
+
+def _base_args(span_id: int = 0, parent: int = 0) -> dict:
+    args = {"trace_id": trace_id()}
+    if span_id:
+        args["span_id"] = span_id
+    if parent:
+        args["parent_span_id"] = parent
+    return args
+
+
+# ----------------------------------------------------------------------
+# registry scope hooks (the span stack)
+# ----------------------------------------------------------------------
+
+class _Hooks:
+    """Installed into obs.registry so StageTimer.scope opens/closes
+    spans without the registry importing this module."""
+
+    @staticmethod
+    def active() -> bool:
+        return active()
+
+    @staticmethod
+    def begin(name: str):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        span_id = next(_span_seq)
+        parent = stack[-1] if stack else 0
+        stack.append(span_id)
+        return (name, span_id, parent, _now_us())
+
+    @staticmethod
+    def end(token) -> None:
+        name, span_id, parent, t0 = token
+        stack = getattr(_tls, "stack", None)
+        if stack:
+            # normally a plain pop; sweep on mismatch so one leaked
+            # scope cannot corrupt every later parent link
+            if stack[-1] == span_id:
+                stack.pop()
+            elif span_id in stack:
+                del stack[stack.index(span_id):]
+        _push({"name": name, "ph": "X", "ts": t0,
+               "dur": max(_now_us() - t0, 0.001),
+               "pid": process_index(), "tid": _thread_lane(),
+               "cat": "stage", "args": _base_args(span_id, parent)})
+
+    @staticmethod
+    def ready_span(name: str, t0_perf: float, t1_perf: float,
+                   queued_s: float = 0.0) -> None:
+        """Device-readiness span from the registry's async drainer."""
+        span_id = next(_span_seq)
+        args = _base_args(span_id)
+        args["queued_ms"] = round(queued_s * 1e3, 3)
+        _push({"name": name + "::ready", "ph": "X",
+               "ts": _perf_to_us(t0_perf),
+               "dur": max((t1_perf - t0_perf) * 1e6, 0.001),
+               "pid": process_index(),
+               "tid": _lane(kReadyLane, kReadyLane),
+               "cat": "ready", "args": args})
+
+
+_install_trace_hooks(_Hooks)
+
+
+# ----------------------------------------------------------------------
+# event tap (events.emit → instant events / compile spans)
+# ----------------------------------------------------------------------
+
+def _note_event(rec: dict) -> None:
+    if rec.get("event") == "jit_trace":
+        # render the Python-trace window as a span on the compile lane;
+        # cost_analysis fields captured by obs/compile.py ride in args.
+        # Deferred replays carry ended_ts — the trace really finished
+        # back then, so the span is placed at its true time
+        dur = max(float(rec.get("trace_seconds", 0.0)) * 1e6, 0.001)
+        end_us = float(rec.get("ended_ts") or rec.get("ts") or 0.0) * 1e6
+        if not end_us:
+            end_us = _now_us()
+        args = _base_args(next(_span_seq))
+        for k in ("fn", "count", "trace_seconds", "flops",
+                  "bytes_accessed", "hlo_bytes"):
+            if k in rec:
+                args[k] = rec[k]
+        # per-thread compile lane: concurrent traces (serve worker vs
+        # trainer) must not partially overlap on one lane
+        _push({"name": "jit::%s" % rec.get("fn", "?"), "ph": "X",
+               "ts": end_us - dur, "dur": dur,
+               "pid": process_index(),
+               "tid": _lane((kCompileLane, threading.get_ident()),
+                            kCompileLane),
+               "cat": "compile", "args": args})
+        return
+    args = _base_args()
+    for k, v in rec.items():
+        if k not in ("ts", "event"):
+            args[k] = v
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        args["parent_span_id"] = stack[-1]
+    _push({"name": rec.get("event", "?"), "ph": "i", "ts": _now_us(),
+           "s": "t", "pid": process_index(), "tid": _thread_lane(),
+           "cat": "event", "args": args})
+
+
+_events.install_trace_tap(active, _note_event)
+
+
+# ----------------------------------------------------------------------
+# counters / device memory gauges
+# ----------------------------------------------------------------------
+
+def counter(name: str, values: Dict[str, float]) -> None:
+    """Chrome counter track (rendered as a stacked area in Perfetto)."""
+    if not active() or not values:
+        return
+    _push({"name": name, "ph": "C", "ts": _now_us(),
+           "pid": process_index(), "tid": 0, "args": dict(values)})
+
+
+def record_device_memory(reg=registry) -> Dict[str, float]:
+    """Per-iteration HBM gauges: ``device.memory_stats()`` peak /
+    in-use bytes where the backend reports them (TPU/GPU), live-buffer
+    count fallback otherwise (the CPU backend returns None). Lands in
+    the registry's gauges and, when tracing, on a counter track."""
+    out: Dict[str, float] = {}
+    try:
+        import jax
+        dev = jax.devices()[0]
+        stats = None
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            for src, dst in (("bytes_in_use", "device/bytes_in_use"),
+                             ("peak_bytes_in_use",
+                              "device/peak_bytes_in_use"),
+                             ("bytes_limit", "device/bytes_limit")):
+                if src in stats:
+                    val = float(stats[src])
+                    reg.gauge(dst, val)
+                    out[dst] = val
+        else:
+            n = float(len(jax.live_arrays()))
+            reg.gauge("device/live_buffers", n)
+            out["device/live_buffers"] = n
+    except Exception:
+        return out
+    if out:
+        counter("device_memory", out)
+    return out
+
+
+_profiler_session = None  # None = not started, True = live, False = failed
+
+
+def maybe_start_profiler_session(reg=registry) -> bool:
+    """Optional ``jax.profiler`` device-trace session riding sample
+    mode: with ``LIGHTGBM_TPU_TIMETAG=sample`` and
+    ``LIGHTGBM_TPU_PROFILE_DIR=<logdir>`` set, the first sampled
+    iteration starts one trace session (stopped atexit) — the stage
+    scopes' TraceAnnotations then attribute device kernels to the same
+    stage names in TensorBoard/Perfetto, with zero hot-path fences."""
+    global _profiler_session
+    if _profiler_session is not None:
+        return _profiler_session is True
+    logdir = os.environ.get("LIGHTGBM_TPU_PROFILE_DIR")
+    if not logdir or not reg.timer.sampling:
+        return False
+    try:
+        from .registry import start_device_trace, stop_device_trace
+        start_device_trace(logdir)
+        _profiler_session = True
+
+        def _stop():
+            try:
+                stop_device_trace()
+            except Exception:
+                pass
+        atexit.register(_stop)
+        return True
+    except Exception:
+        _profiler_session = False
+        return False
+
+
+def sample_iteration(iter_idx: int, reg=registry) -> None:
+    """Per-iteration telemetry hook for the boosting drivers: device
+    memory gauges (+ the optional profiler session) only under the
+    explicit profiling modes — TIMETAG fencing/sample or an active span
+    trace. Programmatic ``registry.enable()`` alone (the bench's
+    aggregate timing) skips it: the live-buffer fallback walks every
+    live array, which would perturb the measured loop. Cheap no-op when
+    off — safe on the hot path."""
+    if not (reg.timer.sampling or reg.fence() or active()):
+        return
+    maybe_start_profiler_session(reg)
+    record_device_memory(reg)
+
+
+# ----------------------------------------------------------------------
+# export
+# ----------------------------------------------------------------------
+
+def _metadata_events(lanes: Dict[int, str], pid: int) -> List[dict]:
+    meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": "rank %d (%s)"
+                      % (pid, socket.gethostname())}},
+            {"name": "process_sort_index", "ph": "M", "pid": pid,
+             "tid": 0, "args": {"sort_index": pid}}]
+    for lane, name in sorted(lanes.items()):
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": lane, "args": {"name": name}})
+    return meta
+
+
+def flush() -> None:
+    """Drain in-flight readiness watches, then (re)write the whole
+    Chrome-trace JSON to the sink. Never raises — telemetry must not
+    take the caller down."""
+    path = sink_path()
+    if path is None:
+        return
+    try:
+        registry.drain_ready(timeout=5.0)
+        with _lock:
+            if not _events_buf:
+                return
+            pid = process_index()
+            evs = (_metadata_events(dict(_lane_names), pid)
+                   + list(_events_buf))
+            dropped = _dropped
+        doc = {"traceEvents": evs,
+               "displayTimeUnit": "ms",
+               "otherData": {"trace_id": trace_id(),
+                             "host": socket.gethostname(),
+                             "os_pid": os.getpid(),
+                             "process_index": pid,
+                             "dropped_events": dropped,
+                             "producer": "lightgbm_tpu/obs/trace.py"}}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+    except Exception:
+        pass
+
+
+atexit.register(flush)

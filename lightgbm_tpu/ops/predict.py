@@ -295,11 +295,11 @@ def _make_stacked_jits():
     serve cache pads rows so a second dispatch at the same bucket hits
     the jit cache with zero retraces)."""
     from ..obs import compile as obs_compile
-    leaves = jax.jit(
-        obs_compile.traced("serve.stacked_leaves")(_stacked_leaves_body),
+    leaves = obs_compile.instrument_jit(
+        "serve.stacked_leaves", _stacked_leaves_body,
         static_argnames=("trips",))
-    raw = jax.jit(
-        obs_compile.traced("serve.stacked_raw")(_stacked_raw_body),
+    raw = obs_compile.instrument_jit(
+        "serve.stacked_raw", _stacked_raw_body,
         static_argnames=("trips", "K"))
     return leaves, raw
 

@@ -224,19 +224,25 @@ def make_rand_bins(key, meta: "FeatureMeta", params: SplitParams):
     """extra_trees (config.h:368): one random candidate threshold per
     feature per leaf (reference: meta_->rand.NextInt calls in
     feature_histogram.hpp:109,321,402). Returns (numerical threshold,
-    one-hot bin, sorted-prefix position) per feature."""
-    kn, ko, ks = jax.random.split(key, 3)
+    one-hot bin, sorted-prefix position) per feature.
+
+    Seeding contract shared by ALL learners: feature f's draw depends
+    only on (key, f) — each feature folds its index into the node key
+    and draws from its own stream. A whole-vector ``uniform(key, (F,))``
+    draw would make the values depend on the padded feature count,
+    and the serial learner pads F to a multiple of 8 while the mesh
+    learners don't — their extra_trees splits would diverge."""
     F = meta.num_bin.shape[0]
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+        key, jnp.arange(F, dtype=jnp.uint32))
+    u = jax.vmap(lambda k: jax.random.uniform(k, (3,)))(keys)
     rand_num = jnp.floor(
-        jax.random.uniform(kn, (F,))
-        * jnp.maximum(meta.num_bin - 2, 1)).astype(jnp.int32)
+        u[:, 0] * jnp.maximum(meta.num_bin - 2, 1)).astype(jnp.int32)
     rand_oh = 1 + jnp.floor(
-        jax.random.uniform(ko, (F,))
-        * jnp.maximum(meta.num_bin - 1, 1)).astype(jnp.int32)
+        u[:, 1] * jnp.maximum(meta.num_bin - 1, 1)).astype(jnp.int32)
     max_thr = jnp.maximum(
         jnp.minimum(params.max_cat_threshold, (meta.num_bin + 1) // 2), 1)
-    rand_sorted = jnp.floor(
-        jax.random.uniform(ks, (F,)) * max_thr).astype(jnp.int32)
+    rand_sorted = jnp.floor(u[:, 2] * max_thr).astype(jnp.int32)
     return rand_num, rand_oh, rand_sorted
 
 

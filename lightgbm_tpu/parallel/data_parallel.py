@@ -584,9 +584,8 @@ class DataParallelTreeLearner(CapabilityMixin):
     def _adv_scan(self, state, leaf, sums, bound_arrays, depth, allowed,
                   feature_mask):
         if self._adv_rescan_fn is None:
-            self._adv_rescan_fn = jax.jit(
-                obs_compile.traced("mesh.adv_rescan")(
-                    self._adv_rescan_impl),
+            self._adv_rescan_fn = obs_compile.instrument_jit(
+                "mesh.adv_rescan", self._adv_rescan_impl,
                 donate_argnums=(0,))
         sg, sh, c, tc = sums
         min_c, max_c = bound_arrays
@@ -599,10 +598,10 @@ class DataParallelTreeLearner(CapabilityMixin):
     # --- adapter methods for the shared capability drivers ------------
     def _cegb_root(self, gh, feature_mask):
         if self._cegb_root_fn is None:
-            self._cegb_root_fn = jax.jit(
-                obs_compile.traced("mesh.cegb_root")(self._cegb_root_impl))
-            self._cegb_step_fn = jax.jit(
-                obs_compile.traced("mesh.cegb_step")(self._cegb_step_impl),
+            self._cegb_root_fn = obs_compile.instrument_jit(
+                "mesh.cegb_root", self._cegb_root_impl)
+            self._cegb_step_fn = obs_compile.instrument_jit(
+                "mesh.cegb_step", self._cegb_step_impl,
                 donate_argnums=(1,))
         return self._cegb_root_fn(self.bins, gh, feature_mask,
                                   self._cegb_used, self._cegb_fetched)
@@ -628,11 +627,11 @@ class DataParallelTreeLearner(CapabilityMixin):
     def _mono_step(self, state, leaf, k, allowed, feature_mask, bounds,
                    smaller):
         if self._mono_step_fn is None:
-            self._mono_step_fn = jax.jit(
-                obs_compile.traced("mesh.mono_step")(self._mono_step_impl),
+            self._mono_step_fn = obs_compile.instrument_jit(
+                "mesh.mono_step", self._mono_step_impl,
                 donate_argnums=(1,))
-            self._rescan_fn = jax.jit(
-                obs_compile.traced("mesh.rescan")(self._rescan_impl),
+            self._rescan_fn = obs_compile.instrument_jit(
+                "mesh.rescan", self._rescan_impl,
                 donate_argnums=(0,))
         return self._mono_step_fn(
             self.bins, state, jnp.int32(leaf), jnp.int32(k), feature_mask,
@@ -651,8 +650,8 @@ class DataParallelTreeLearner(CapabilityMixin):
     def _node_step(self, state, leaf, k, allowed, mask_left, mask_right,
                    rand_seed, smaller):
         if self._step_fn is None:
-            self._step_fn = jax.jit(
-                obs_compile.traced("mesh.step")(self._step_impl),
+            self._step_fn = obs_compile.instrument_jit(
+                "mesh.step", self._step_impl,
                 donate_argnums=(1,))
         return self._step_fn(self.bins, state, jnp.int32(leaf),
                              jnp.int32(k), mask_left, mask_right,
@@ -661,10 +660,10 @@ class DataParallelTreeLearner(CapabilityMixin):
     # ------------------------------------------------------------------
     def _ensure_compiled(self):
         if self._root_fn is None:
-            self._root_fn = jax.jit(
-                obs_compile.traced("mesh.root")(self._root_impl))
-            self._tree_fn = jax.jit(
-                obs_compile.traced("mesh.tree")(self._tree_impl),
+            self._root_fn = obs_compile.instrument_jit(
+                "mesh.root", self._root_impl)
+            self._tree_fn = obs_compile.instrument_jit(
+                "mesh.tree", self._tree_impl,
                 donate_argnums=(1,))
 
     def _splittable(self, depth: int) -> bool:
@@ -693,8 +692,7 @@ class DataParallelTreeLearner(CapabilityMixin):
         self._ensure_compiled()
         with obs.scope("tree::stage_gh"):
             gh = self._make_gh(grad, hess, bag)
-            if obs.fence():
-                jax.block_until_ready(gh)
+            obs.watch_ready("tree::stage_gh", gh)
             feature_mask = self._sample_features()
 
         tree = Tree(self.L)
@@ -711,8 +709,7 @@ class DataParallelTreeLearner(CapabilityMixin):
         with obs.scope("tree::root_histogram"):
             state, rec = self._root_fn(self.bins, gh, feature_mask,
                                        rand_seed)
-            if obs.fence():
-                jax.block_until_ready(rec)
+            obs.watch_ready("tree::root_histogram", rec)
         if self._needs_per_node_masks():
             state = train_stepwise(self, tree, state, rec, feature_mask,
                                    rand_seed)
@@ -849,11 +846,10 @@ class DataParallelTreeLearner(CapabilityMixin):
         # would re-jit the scan
         if self._many_fn is None or self._many_grad_fn != grad_fn:
             self._many_grad_fn = grad_fn
-            self._many_fn = jax.jit(
-                obs_compile.traced("mesh.train_many")(self._many_impl))
-            self._many_multi_fn = jax.jit(
-                obs_compile.traced("mesh.train_many_multi")(
-                    self._many_impl_multi))
+            self._many_fn = obs_compile.instrument_jit(
+                "mesh.train_many", self._many_impl)
+            self._many_multi_fn = obs_compile.instrument_jit(
+                "mesh.train_many_multi", self._many_impl_multi)
         feature_mask = self._sample_features()
         self._tree_idx += int(seeds.size)
         fn = self._many_multi_fn if seeds.ndim == 2 else self._many_fn

@@ -33,6 +33,7 @@ from ..metric import create_metric
 from ..objective import create_objective
 from ..obs import events as obs_events
 from ..obs import health as obs_health
+from ..obs import trace as obs_trace
 from ..obs.registry import registry as obs
 from ..utils import log
 from .distributed import (DistributedDataParallelLearner,
@@ -68,6 +69,19 @@ def train(params: Dict, local_X: np.ndarray, local_y: np.ndarray,
     (``local_group`` per process), like the reference's pre-partitioned
     distributed data (config.h pre_partition)."""
     config = Config.from_params(params)
+    if obs_trace.active():
+        # one trace file per rank, pid = the rank: ranks share one
+        # LIGHTGBM_TPU_TRACE value, the rank is folded into the file
+        # name, and tools/trace_report.py merge interleaves the files
+        # into per-rank Perfetto lanes. Re-point the sink BEFORE any
+        # event lands (record_backend below) — configure() flushes the
+        # current buffer to the current path, and ranks must never
+        # write the shared un-ranked file
+        rank = int(jax.process_index())
+        obs_trace.configure(obs_trace.rank_path(obs_trace.sink_path(),
+                                                rank),
+                            process_index_override=rank,
+                            keep_buffer=True)
     obs_health.record_backend_once(source="dtrain")
     local_X = np.asarray(local_X, dtype=np.float64)
     local_y = np.asarray(local_y, dtype=np.float64)
@@ -181,6 +195,7 @@ def train(params: Dict, local_X: np.ndarray, local_y: np.ndarray,
                 tree.add_bias(init_scores[k])
             trees.append(tree)
             iter_trees.append(tree)
+        obs_trace.sample_iteration(it + 1)
         if obs_events.enabled():
             obs_events.emit(
                 "train_iter", iter=it + 1,

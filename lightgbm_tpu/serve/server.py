@@ -260,7 +260,11 @@ class PredictServer:
             X = (batch[0].x if len(batch) == 1
                  else np.concatenate([r.x for r in batch], axis=0))
             t0 = time.perf_counter()
-            y = self.predictor.predict(X)
+            # stage scope so coalesced serving dispatches render as
+            # spans on the worker's trace lane next to the training
+            # stages (the `predict_batch` event rides along as usual)
+            with obs.scope("serve::predict_batch"):
+                y = self.predictor.predict(X)
             dt = time.perf_counter() - t0
         except Exception as e:  # noqa: BLE001 — a bad batch must not
             for r in batch:     # kill the worker; fail its futures

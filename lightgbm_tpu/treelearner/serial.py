@@ -488,7 +488,7 @@ def _root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
                                 children_allowed)
         return state, _record_at(state, 0)
 
-    return jax.jit(obs_compile.traced("serial.root")(root))
+    return obs_compile.instrument_jit("serial.root", root)
 
 
 @functools.lru_cache(maxsize=None)
@@ -512,8 +512,8 @@ def _step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best)
 
-    return jax.jit(obs_compile.traced("serial.step")(step),
-                   donate_argnums=(1,))
+    return obs_compile.instrument_jit("serial.step", step,
+                                      donate_argnums=(1,))
 
 
 def _cegb_penalty(params, count, used, coupled, unfetched, lazy):
@@ -555,7 +555,7 @@ def _cegb_root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
                                 children_allowed)
         return state, _record_at(state, 0)
 
-    return jax.jit(obs_compile.traced("serial.cegb_root")(root))
+    return obs_compile.instrument_jit("serial.cegb_root", root)
 
 
 @functools.lru_cache(maxsize=None)
@@ -614,8 +614,8 @@ def _cegb_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), used2, fetched2
 
-    return jax.jit(obs_compile.traced("serial.cegb_step")(step),
-                   donate_argnums=(1,))
+    return obs_compile.instrument_jit("serial.cegb_step", step,
+                                      donate_argnums=(1,))
 
 
 @functools.lru_cache(maxsize=None)
@@ -644,8 +644,8 @@ def _mono_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), state.gain
 
-    return jax.jit(obs_compile.traced("serial.mono_step")(step),
-                   donate_argnums=(1,))
+    return obs_compile.instrument_jit("serial.mono_step", step,
+                                      donate_argnums=(1,))
 
 
 @functools.lru_cache(maxsize=None)
@@ -668,8 +668,8 @@ def _rescan_fn_cached(B: int, has_cat: bool = True):
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), state.gain
 
-    return jax.jit(obs_compile.traced("serial.rescan")(rescan),
-                   donate_argnums=(0,))
+    return obs_compile.instrument_jit("serial.rescan", rescan,
+                                      donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=None)
@@ -694,8 +694,8 @@ def _adv_rescan_fn_cached(B: int, has_cat: bool = True):
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), state.gain
 
-    return jax.jit(obs_compile.traced("serial.adv_rescan")(rescan),
-                   donate_argnums=(0,))
+    return obs_compile.instrument_jit("serial.adv_rescan", rescan,
+                                      donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=None)
@@ -746,8 +746,8 @@ def _forced_fn_cached(S: int, B: int, Bg: int, bundled: bool,
                             rand_seed=rand_seed)
         return state, rec, ok
 
-    return jax.jit(obs_compile.traced("serial.forced")(forced),
-                   donate_argnums=(1,))
+    return obs_compile.instrument_jit("serial.forced", forced,
+                                      donate_argnums=(1,))
 
 
 @functools.lru_cache(maxsize=None)
@@ -782,8 +782,8 @@ def _batch_fn_cached(S: int, kb: int, B: int, Bg: int, bundled: bool,
             0, kb, body, (state, _empty_records(kb, B)))
         return state, recs
 
-    return jax.jit(obs_compile.traced("serial.batch")(batch),
-                   donate_argnums=(1,))
+    return obs_compile.instrument_jit("serial.batch", batch,
+                                      donate_argnums=(1,))
 
 
 class SerialTreeLearner(CapabilityMixin):
@@ -997,11 +997,10 @@ class SerialTreeLearner(CapabilityMixin):
             gh = jnp.concatenate(
                 [gh, jnp.zeros((self.R - self.N, 4), dtype=jnp.float32)],
                 axis=0)
-            if obs.fence():
-                # fence so the staging cost lands in THIS stage, not in
-                # whichever later scope first synchronizes (the tunnel's
-                # async dispatch smears phases otherwise)
-                jax.block_until_ready(gh)
+            # fencing mode blocks here so the staging cost lands in THIS
+            # stage; sample/trace mode hands the output to the async
+            # readiness drainer instead (no hot-path fence)
+            obs.watch_ready("tree::stage_gh", gh)
             feature_mask = self._sample_features()
 
         tree = Tree(self.L)
@@ -1021,8 +1020,7 @@ class SerialTreeLearner(CapabilityMixin):
                                        feature_mask, self._splittable(0),
                                        rand_seed, self.meta, self.params,
                                        self._btab)
-            if obs.fence():
-                jax.block_until_ready(rec)
+            obs.watch_ready("tree::root_histogram", rec)
         leaf_total = {0: float(self.N)}
         next_leaf = 1
         if self._forced is not None:

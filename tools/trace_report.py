@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""Chrome-trace toolbox for lightgbm_tpu span traces: validate, merge,
+summarize.
+
+Stdlib-only on purpose — it must load in <100 ms from CI and never drag
+jax into a trace-processing subprocess.
+
+Subcommands::
+
+    trace_report.py validate trace.json
+        Schema + span-nesting check (complete events properly nested
+        per (pid, tid) lane, ids resolvable, timestamps sane).
+        Exit 0 when valid, 1 with one error per line otherwise.
+
+    trace_report.py merge -o merged.json rank0.json rank1.json ...
+        Interleave per-rank trace files by wall clock into ONE
+        Perfetto-loadable file. Each input keeps (or, on collision, is
+        remapped to) a distinct pid, so ranks render as separate
+        process lanes. Prints the aggregate stage table of the merged
+        trace to stdout.
+
+    trace_report.py summary trace.json [more.json ...]
+        Aggregate spans into the same stage table BENCH phases consume:
+        {"phases": {stage: {seconds, calls, p50_ms, p99_ms}}}.
+
+The traces come from ``LIGHTGBM_TPU_TRACE=path.json`` (see
+docs/OBSERVABILITY.md); multi-process dtrain writes one file per rank
+(``path.rankN.json``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Tuple
+
+# spans may be emitted from perf_counter-anchored clocks; allow this
+# much boundary slop (microseconds) before calling nesting broken
+kNestEpsUs = 5.0
+
+kKnownPhases = {"X", "i", "C", "M", "b", "e", "n"}
+
+
+def load_trace(path: str) -> dict:
+    """Load a Chrome-trace file; normalizes the bare-array form to the
+    object form."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):
+        doc = {"traceEvents": doc}
+    if not isinstance(doc, dict):
+        raise ValueError("%s: not a Chrome-trace JSON object" % path)
+    return doc
+
+
+def _spans(doc: dict) -> List[dict]:
+    return [e for e in doc.get("traceEvents", [])
+            if isinstance(e, dict) and e.get("ph") == "X"]
+
+
+def validate_trace(doc: dict) -> List[str]:
+    """Return a list of schema/nesting errors (empty = valid)."""
+    errors: List[str] = []
+    evs = doc.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["traceEvents is not a list"]
+    if not evs:
+        return ["traceEvents is empty"]
+    span_ids = set()
+    for i, e in enumerate(evs):
+        if not isinstance(e, dict):
+            errors.append("event %d: not an object" % i)
+            continue
+        ph = e.get("ph")
+        if ph not in kKnownPhases:
+            errors.append("event %d: unknown ph %r" % (i, ph))
+            continue
+        if ph == "M":
+            continue
+        if "pid" not in e or "tid" not in e:
+            errors.append("event %d (%s): missing pid/tid"
+                          % (i, e.get("name")))
+        if ph in ("X", "i", "C"):
+            ts = e.get("ts")
+            if not isinstance(ts, (int, float)):
+                errors.append("event %d (%s): bad ts %r"
+                              % (i, e.get("name"), ts))
+        if ph == "X":
+            if not isinstance(e.get("name"), str) or not e.get("name"):
+                errors.append("event %d: span without a name" % i)
+            dur = e.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append("event %d (%s): bad dur %r"
+                              % (i, e.get("name"), dur))
+            args = e.get("args") or {}
+            sid = args.get("span_id")
+            if sid is not None:
+                # span ids are unique per trace_id (merged multi-rank
+                # files legitimately repeat ids across ranks)
+                key = (args.get("trace_id"), sid)
+                if key in span_ids:
+                    errors.append("duplicate span_id %r in trace %r"
+                                  % (sid, args.get("trace_id")))
+                span_ids.add(key)
+    if errors:
+        return errors
+    # parent links resolve within the same trace_id's span set
+    for e in _spans(doc):
+        args = e.get("args") or {}
+        parent = args.get("parent_span_id")
+        if parent not in (None, 0) \
+                and (args.get("trace_id"), parent) not in span_ids:
+            errors.append("span %r (%s): parent_span_id %r unknown"
+                          % (args.get("span_id"), e.get("name"), parent))
+    errors.extend(_check_nesting(doc))
+    return errors
+
+
+def _check_nesting(doc: dict) -> List[str]:
+    """Spans on one (pid, tid) lane must be properly nested or
+    disjoint — monotone nesting, no partial overlap."""
+    errors: List[str] = []
+    lanes: Dict[Tuple, List[dict]] = {}
+    for e in _spans(doc):
+        lanes.setdefault((e.get("pid"), e.get("tid")), []).append(e)
+    for lane, spans in lanes.items():
+        spans.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack: List[dict] = []  # enclosing spans, innermost last
+        for e in spans:
+            t0, t1 = e["ts"], e["ts"] + e["dur"]
+            while stack and t0 >= stack[-1]["ts"] + stack[-1]["dur"] \
+                    - kNestEpsUs:
+                stack.pop()
+            if stack:
+                p0 = stack[-1]["ts"]
+                p1 = p0 + stack[-1]["dur"]
+                if t1 > p1 + kNestEpsUs or t0 < p0 - kNestEpsUs:
+                    errors.append(
+                        "lane %r: span %r [%0.1f, %0.1f] partially "
+                        "overlaps %r [%0.1f, %0.1f]"
+                        % (lane, e.get("name"), t0, t1,
+                           stack[-1].get("name"), p0, p1))
+                    continue
+            stack.append(e)
+    return errors
+
+
+def span_tree(doc: dict) -> Dict:
+    """Reconstruct the span forest from parent_span_id links:
+    {span_id: {"name", "parent", "children": [span_id...]}}."""
+    nodes: Dict = {}
+    for e in _spans(doc):
+        args = e.get("args") or {}
+        sid = args.get("span_id")
+        if sid is None:
+            continue
+        nodes[sid] = {"name": e.get("name"),
+                      "ts": e.get("ts"), "dur": e.get("dur"),
+                      "parent": args.get("parent_span_id") or 0,
+                      "children": []}
+    for sid, n in nodes.items():
+        p = n["parent"]
+        if p and p in nodes:
+            nodes[p]["children"].append(sid)
+    return nodes
+
+
+def merge_traces(paths: List[str]) -> dict:
+    """Combine per-rank trace files: distinct process lanes (pids
+    remapped on collision), events interleaved by wall-clock ts."""
+    merged: List[dict] = []
+    other: List[dict] = []
+    used_pids = set()
+    for path in paths:
+        doc = load_trace(path)
+        file_pids = sorted({e.get("pid") for e in doc["traceEvents"]
+                            if isinstance(e, dict) and "pid" in e},
+                           key=lambda p: (p is None, p))
+        remap = {}
+        for pid in file_pids:
+            new = pid if isinstance(pid, int) else 0
+            while new in used_pids:
+                new += 1
+            remap[pid] = new
+            used_pids.add(new)
+        named = {e.get("pid") for e in doc["traceEvents"]
+                 if isinstance(e, dict) and e.get("ph") == "M"
+                 and e.get("name") == "process_name"}
+        for e in doc["traceEvents"]:
+            if not isinstance(e, dict):
+                continue
+            e = dict(e)
+            if "pid" in e:
+                e["pid"] = remap.get(e["pid"], e["pid"])
+            merged.append(e)
+        for old, new in remap.items():
+            if old not in named:
+                merged.append({"name": "process_name", "ph": "M",
+                               "pid": new, "tid": 0,
+                               "args": {"name": "rank %s (%s)"
+                                        % (new, path)}})
+        od = doc.get("otherData")
+        if od:
+            other.append(dict(od, source_file=path))
+    meta = [e for e in merged if e.get("ph") == "M"]
+    rest = sorted((e for e in merged if e.get("ph") != "M"),
+                  key=lambda e: e.get("ts", 0.0))
+    return {"traceEvents": meta + rest,
+            "displayTimeUnit": "ms",
+            "otherData": {"merged_from": paths, "ranks": other}}
+
+
+def summarize(doc: dict) -> dict:
+    """Aggregate spans into the BENCH-shaped stage table."""
+    per_stage: Dict[str, List[float]] = {}
+    for e in _spans(doc):
+        per_stage.setdefault(e["name"], []).append(e["dur"] / 1e6)
+    phases = {}
+    for name, durs in sorted(per_stage.items()):
+        sv = sorted(durs)
+        phases[name] = {
+            "seconds": round(sum(durs), 6),
+            "calls": len(durs),
+            "p50_ms": round(_percentile(sv, 50) * 1e3, 3),
+            "p99_ms": round(_percentile(sv, 99) * 1e3, 3),
+        }
+    return {"phases": phases}
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    k = (len(sorted_vals) - 1) * (q / 100.0)
+    f = int(k)
+    c = min(f + 1, len(sorted_vals) - 1)
+    return sorted_vals[f] + (sorted_vals[c] - sorted_vals[f]) * (k - f)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_report.py",
+        description="validate / merge / summarize lightgbm_tpu "
+                    "Chrome-trace files")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    ap_v = sub.add_parser("validate", help="schema + nesting check")
+    ap_v.add_argument("path")
+    ap_m = sub.add_parser("merge", help="merge per-rank traces")
+    ap_m.add_argument("-o", "--output", required=True)
+    ap_m.add_argument("paths", nargs="+")
+    ap_s = sub.add_parser("summary", help="aggregate stage table")
+    ap_s.add_argument("paths", nargs="+")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "validate":
+        try:
+            doc = load_trace(args.path)
+        except (OSError, ValueError) as e:
+            print("INVALID: %s" % e, file=sys.stderr)
+            return 1
+        errors = validate_trace(doc)
+        if errors:
+            for err in errors:
+                print("INVALID: %s" % err, file=sys.stderr)
+            return 1
+        spans = _spans(doc)
+        print("OK: %d events, %d spans, %d stages"
+              % (len(doc["traceEvents"]), len(spans),
+                 len({e["name"] for e in spans})))
+        return 0
+
+    if args.cmd == "merge":
+        merged = merge_traces(args.paths)
+        with open(args.output, "w") as f:
+            json.dump(merged, f)
+        print(json.dumps(summarize(merged), indent=2))
+        return 0
+
+    if args.cmd == "summary":
+        if len(args.paths) == 1:
+            doc = load_trace(args.paths[0])
+        else:
+            doc = merge_traces(args.paths)
+        print(json.dumps(summarize(doc), indent=2))
+        return 0
+
+    return 2
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `trace_report.py summary ... | head` closing the pipe early
+        # is not an error
+        sys.exit(0)
