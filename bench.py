@@ -180,6 +180,122 @@ def _bench_predict(booster, n_feat: int) -> dict:
         return {"predict_rows_per_sec": 0.0, "predict_rows": rows}
 
 
+def run_hist_microbench() -> dict:
+    """Standalone histogram-kernel microbench (``python bench.py hist``
+    or BENCH_HIST=1): rows x features x bins sweep over exact-f32 vs
+    quantized-int8 gh, each under the auto-selected backend AND the
+    one-hot einsum path. ``hist_gb_per_sec`` counts the INPUT traffic
+    (bins + gh bytes) the kernel must move per pass — the op is
+    bandwidth-bound (arXiv 1706.08359 / 1806.11248), so GB/s is the
+    honest unit and the quantized win is visible in isolation from the
+    grow loop. Every measurement lands in bench_stages.jsonl."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.histogram import (build_histogram,
+                                            resolve_hist_impl)
+    from lightgbm_tpu.ops.quantize import (effective_quant_max,
+                                           quant_dtype, quantize_gh)
+
+    _enable_compile_cache()
+    platform = jax.devices()[0].platform
+    rows = int(os.environ.get("BENCH_HIST_ROWS", 1 << 20))
+    # measurement seconds per variant: repeat until this much wall time
+    # has accumulated (raise it on noisy/slow backends for stabler
+    # numbers), with a rep cap as a runaway guard
+    budget = float(os.environ.get("BENCH_HIST_BUDGET", 1.0))
+    rng = np.random.RandomState(0)
+    shapes = [(rows, 28, 255), (rows, 28, 64),
+              (max(rows // 8, 1 << 16), 28, 255)]
+    _stage("hist_bench_start", platform=platform, rows=rows)
+
+    def timed(fn, bins_d, gh_d):
+        jax.block_until_ready(fn(bins_d, gh_d))          # compile + warm
+        t0 = time.time()
+        reps = 0
+        while True:
+            jax.block_until_ready(fn(bins_d, gh_d))
+            reps += 1
+            dt = time.time() - t0
+            if dt >= budget or reps >= 256:
+                break
+        return (time.time() - t0) / reps
+
+    results = []
+    for S, F, B in shapes:
+        bins = rng.randint(0, B, size=(S, F)).astype(np.uint8)
+        g = rng.randn(S).astype(np.float32)
+        h = np.abs(rng.randn(S)).astype(np.float32) + 0.1
+        ones = np.ones(S, dtype=np.float32)
+        gh_f32 = jnp.asarray(np.stack([g, h, ones, ones], axis=1))
+        qmax = effective_quant_max(8, S)
+        gh_i8, _ = quantize_gh(jnp.asarray(g), jnp.asarray(h),
+                               jnp.asarray(ones), jax.random.PRNGKey(0),
+                               qmax, quant_dtype(8))
+        gh_i8 = jax.block_until_ready(gh_i8)
+        bins_d = jnp.asarray(bins)
+        variants = [
+            ("exact_auto", gh_f32, resolve_hist_impl("auto")),
+            ("exact_onehot", gh_f32, resolve_hist_impl("onehot")),
+            ("quant8_auto", gh_i8, resolve_hist_impl("auto", False, 8)),
+            ("quant8_onehot", gh_i8,
+             resolve_hist_impl("onehot", False, 8)),
+        ]
+        for name, gh_d, impl in variants:
+            fn = jax.jit(functools.partial(
+                build_histogram, num_bins=B, hist_impl=impl))
+            try:
+                sec = timed(fn, bins_d, gh_d)
+            except Exception as e:  # keep the sweep alive
+                _stage("hist_bench_failed", variant=name, S=S, F=F, B=B,
+                       detail="%s: %s" % (type(e).__name__, str(e)[:200]))
+                continue
+            in_bytes = S * F * bins.itemsize + S * 4 * gh_d.dtype.itemsize
+            gbps = in_bytes / sec / 1e9
+            rec = dict(variant=name, S=S, F=F, B=B,
+                       seconds=round(sec, 6),
+                       hist_gb_per_sec=round(gbps, 4))
+            results.append(rec)
+            _stage("hist_microbench", **rec)
+
+    def _get(variant, S, F, B):
+        for r in results:
+            if (r["variant"], r["S"], r["F"], r["B"]) == (variant, S, F, B):
+                return r
+        return None
+
+    S0, F0, B0 = shapes[0]
+    quant = _get("quant8_auto", S0, F0, B0)
+    onehot = _get("exact_onehot", S0, F0, B0)
+    exact = _get("exact_auto", S0, F0, B0)
+    speedup_oh = (onehot["seconds"] / quant["seconds"]
+                  if quant and onehot else 0.0)
+    speedup_auto = (exact["seconds"] / quant["seconds"]
+                    if quant and exact else 0.0)
+    # headline = the TIME-based speedup: per-variant hist_gb_per_sec
+    # counts each variant's OWN input bytes, so the quantized number
+    # falls as its inputs shrink even when the kernel got faster —
+    # comparable across variants only via wall time
+    out = {
+        "metric": "hist_speedup_int8_vs_exact_onehot",
+        "value": round(speedup_oh, 3),
+        "unit": "x wall-time speedup, quantized-int8 vs exact-f32 "
+                "one-hot on %s (S=%d F=%d B=%d); %.2fx vs exact f32 "
+                "auto; per-variant input-traffic GB/s in sweep[]"
+                % (platform, S0, F0, B0, speedup_auto),
+        "backend": platform,
+        "hist_gb_per_sec": quant["hist_gb_per_sec"] if quant else 0.0,
+        "hist_speedup_vs_exact_onehot": round(speedup_oh, 3),
+        "hist_speedup_vs_exact_auto": round(speedup_auto, 3),
+        "sweep": results,
+    }
+    _stage("hist_bench_done", speedup_vs_onehot=round(speedup_oh, 3),
+           speedup_vs_auto=round(speedup_auto, 3))
+    return out
+
+
 def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
     if n_rows is None:
         n_rows = int(os.environ.get("BENCH_ROWS", HIGGS_ROWS))
@@ -436,6 +552,26 @@ def _run_escalating(platform: str) -> dict:
 
 
 def main() -> None:
+    if (os.environ.get("BENCH_HIST")
+            or (len(sys.argv) > 1 and sys.argv[1] == "hist")):
+        # standalone histogram microbench: no probe dance — it is cheap
+        # enough to run wherever jax lands (CPU included), and a tunnel
+        # environment still gets scrubbed by the stage-child machinery
+        # of the full bench, not needed here
+        if os.environ.get("JAX_PLATFORMS") in (None, "") \
+                and not os.environ.get("PALLAS_AXON_POOL_IPS"):
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            result = run_hist_microbench()
+        except Exception as e:
+            result = {"metric": "hist_speedup_int8_vs_exact_onehot",
+                      "value": 0.0,
+                      "unit": "x (FAILED: %s: %s)"
+                              % (type(e).__name__, str(e)[:300])}
+            print(json.dumps(result))
+            sys.exit(1)
+        print(json.dumps(result))
+        return
     platform = "cpu"
     if not os.environ.get("BENCH_CHILD"):
         os.environ["BENCH_CHILD"] = "1"

@@ -12,6 +12,17 @@ Training score update uses the learner's final row→leaf partition — a
 device gather of the tree's leaf values — rather than re-walking the tree
 (the trick the reference's CUDADataPartition::UpdateTrainScore uses,
 src/treelearner/cuda/cuda_data_partition.cu).
+
+Quantized-gradient training (``Config.use_quantized_grad``,
+``quant_grad_bits`` ∈ {8, 16}; reference: GBDT's gradient_discretizer_
+member, src/treelearner/gradient_discretizer.cpp): each tree's (grad,
+hess) rows discretize to signed integers with a per-iteration scale and
+stochastic rounding (``ops/quantize.py quantize_gh``) and every
+learner accumulates integer histograms (exact, order-invariant, half
+the psum bytes on meshes) that the split scan dequantizes once. The
+discretization runs inside the learners' gh-staging step
+(``CapabilityMixin._quantize_stage``) so the draw happens on the
+unpadded row vector — padding-invariant across serial/mesh learners.
 """
 from __future__ import annotations
 

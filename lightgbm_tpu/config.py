@@ -110,6 +110,8 @@ _alias("is_provide_training_metric", "training_metric", "is_training_metric",
        "train_metric")
 _alias("eval_at", "ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at")
 _alias("num_class", "num_classes")
+_alias("use_quantized_grad", "use_quantized_gradients", "quantized_grad")
+_alias("quant_grad_bits", "num_grad_quant_bins_bits", "grad_quant_bits")
 _alias("num_machines", "num_machine")
 _alias("local_listen_port", "local_port", "port")
 _alias("machine_list_filename", "machine_list_file", "machine_list", "mlist")
@@ -340,6 +342,15 @@ class Config:
     num_gpu: int = 1
     # TPU additions:
     tpu_use_f64_hist: bool = False   # analogue of gpu_use_dp (f64 hist accum)
+    # quantized-gradient training (reference: use_quantized_grad +
+    # num_grad_quant_bins, config.h / gradient_discretizer.cpp):
+    # per-iteration (grad, hess) discretization to int8/int16 rows with
+    # stochastic rounding; histograms accumulate in int32/int64 (exact
+    # subtraction), split gain dequantizes once per scan. 4x fewer
+    # bandwidth bytes through the histogram hot op, int-MXU matmuls on
+    # TPU, and half the psum bytes on data-parallel meshes.
+    use_quantized_grad: bool = False
+    quant_grad_bits: int = 8         # 8 or 16
     # run N boosting iterations per device dispatch when nothing needs
     # per-iteration host work (boosting/gbdt.py train_batch); amortizes
     # remote-chip dispatch latency. 0/1 = per-iteration training.
@@ -431,6 +442,8 @@ class Config:
             log.fatal("num_class must be 1 for non-multiclass objectives")
         if self.top_rate + self.other_rate > 1.0:
             log.fatal("top_rate + other_rate cannot be larger than 1.0")
+        if self.quant_grad_bits not in (8, 16):
+            log.fatal("quant_grad_bits must be 8 or 16")
         self._warn_unimplemented()
         log.set_verbosity(self.verbosity)
 

@@ -166,6 +166,14 @@ def _extract_cost(lowered) -> dict:
                 cost["flops"] = float(ca["flops"])
             if "bytes accessed" in ca:
                 cost["bytes_accessed"] = float(ca["bytes accessed"])
+            # bytes/FLOP roofline position: > the hardware's balance
+            # point means the program is bandwidth-bound — exactly what
+            # the quantized histogram mode attacks (fewer bytes, same
+            # one-hot FLOPs), so the ratio is the direct evidence of
+            # the bytes moving
+            if cost.get("flops", 0) > 0 and "bytes_accessed" in cost:
+                cost["bytes_per_flop"] = round(
+                    cost["bytes_accessed"] / cost["flops"], 6)
     except Exception:
         pass
     try:
@@ -195,6 +203,9 @@ def _capture_cost(name: str, jitted, args, kwargs, deferred) -> None:
         _pending(create=True)[name] = cost
         if "flops" in cost:
             registry.gauge("compile/%s/flops" % name, cost["flops"])
+        if "bytes_accessed" in cost:
+            registry.gauge("compile/%s/bytes_accessed" % name,
+                           cost["bytes_accessed"])
         if "hlo_bytes" in cost:
             registry.gauge("compile/%s/hlo_bytes" % name,
                            float(cost["hlo_bytes"]))

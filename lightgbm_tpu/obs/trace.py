@@ -278,7 +278,7 @@ def _note_event(rec: dict) -> None:
             end_us = _now_us()
         args = _base_args(next(_span_seq))
         for k in ("fn", "count", "trace_seconds", "flops",
-                  "bytes_accessed", "hlo_bytes"):
+                  "bytes_accessed", "bytes_per_flop", "hlo_bytes"):
             if k in rec:
                 args[k] = rec[k]
         # per-thread compile lane: concurrent traces (serve worker vs

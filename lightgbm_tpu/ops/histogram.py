@@ -38,23 +38,38 @@ PALLAS_ROW_TILE = 2048
 
 
 def resolve_hist_impl(backend: str = "auto",
-                      f64: bool = False) -> tuple:
-    """Validate Config.hist_backend / Config.tpu_use_f64_hist into a
-    static (backend, f64) pair the learners thread through their
-    compiled-step cache keys (the latter is the analogue of the
-    reference's gpu_use_dp, docs/GPU-Performance.rst). f64 accumulation
-    requires jax_enable_x64 and disables the Pallas kernel (f32-only)."""
+                      f64: bool = False,
+                      quant_bits: int = 0) -> tuple:
+    """Validate Config.hist_backend / Config.tpu_use_f64_hist /
+    Config.use_quantized_grad into a static (backend, f64, quant_bits)
+    triple the learners thread through their compiled-step cache keys
+    (f64 is the analogue of the reference's gpu_use_dp,
+    docs/GPU-Performance.rst; quant_bits > 0 selects the integer
+    accumulation paths of ops/quantize.py). f64 accumulation requires
+    jax_enable_x64 and disables the Pallas kernel (f32-only); it is
+    moot under quantization (integer accumulation is already exact), so
+    the two together resolve to the quantized mode."""
     backend = (backend or "auto").lower()
     if backend not in ("auto", "onehot", "pallas", "scatter"):
         from ..utils import log
         log.warning("unknown hist_backend=%s; using auto" % backend)
         backend = "auto"
+    quant_bits = int(quant_bits or 0)
+    if quant_bits not in (0, 8, 16):
+        from ..utils import log
+        log.warning("quant_grad_bits must be 8 or 16; got %d — using 8"
+                    % quant_bits)
+        quant_bits = 8
+    if f64 and quant_bits:
+        _warn_once("tpu_use_f64_hist is ignored under use_quantized_grad "
+                   "(integer histogram accumulation is already exact)")
+        f64 = False
     if f64 and not jax.config.jax_enable_x64:
         from ..utils import log
         log.warning("tpu_use_f64_hist needs jax_enable_x64; histograms "
                     "stay f32")
         f64 = False
-    return backend, bool(f64)
+    return backend, bool(f64), quant_bits
 
 
 # VMEM budget for the Pallas kernel's resident blocks (accumulator +
@@ -64,22 +79,33 @@ PALLAS_VMEM_BUDGET = 64 * 1024 * 1024
 
 
 def _pallas_fits(F: int, num_bins: int, C: int,
-                 T: int = PALLAS_ROW_TILE) -> bool:
+                 T: int = PALLAS_ROW_TILE, itemsize: int = 4) -> bool:
     """Static VMEM bound for the kernel's working set: the [F*H, 16*C]
-    accumulator stays resident across the grid, plus the per-step row
-    tile and its one-hot/replicated transients."""
+    accumulator (always 4-byte f32/int32) stays resident across the
+    grid, plus the per-step row tile and its one-hot/replicated
+    transients at the input itemsize (1 byte in int8 mode — which is
+    what lets the quantized kernel run a 4x wider row tile)."""
     H = -(-num_bins // 16)
     acc = F * H * 16 * C * 4
-    tile = T * F * 4 + T * C * 4                 # bins + gh blocks
-    trans = T * 16 * C * 4 * 2 + T * H * 4       # g_rep, W, A
+    tile = T * F * itemsize + T * C * itemsize   # bins + gh blocks
+    trans = T * 16 * C * itemsize * 2 + T * H * itemsize  # g_rep, W, A
     return acc + tile + trans <= PALLAS_VMEM_BUDGET
 
 
-def _warn_once(msg: str) -> None:
+def _warn_once(msg: str, component: str = "ops.histogram") -> None:
     """One warning per distinct message — but only count it as warned
     when the current verbosity actually emits it, so a training run at
-    verbosity=-1 does not permanently swallow the downgrade notice."""
+    verbosity=-1 does not permanently swallow the downgrade notice.
+    Every distinct message ALSO emits one ``perf_warning`` event
+    (regardless of verbosity — the events sink is how tests assert that
+    no silent backend fallback happened). ``component`` names the
+    module the condition originates in for event-log consumers."""
     from ..utils import log
+    if msg not in _warn_once._emitted:
+        _warn_once._emitted.add(msg)
+        from ..obs import events as obs_events
+        obs_events.emit("perf_warning", component=component,
+                        message=msg)
     if log._level < log.LogLevel.WARNING:
         return
     if msg in _warn_once._seen:
@@ -89,6 +115,22 @@ def _warn_once(msg: str) -> None:
 
 
 _warn_once._seen = set()
+_warn_once._emitted = set()
+
+
+def _reset_warn_once() -> None:
+    """Clear the one-per-message dedup on registry reset (the
+    obs/compile._WARNED pattern): a new run — or a test that resets the
+    registry — must get its own warning AND its own assertable
+    perf_warning event, not a silence inherited from the previous
+    run."""
+    _warn_once._seen.clear()
+    _warn_once._emitted.clear()
+
+
+from ..obs.registry import add_reset_hook  # noqa: E402
+
+add_reset_hook(_reset_warn_once)
 
 
 @functools.lru_cache(maxsize=1)
@@ -119,6 +161,16 @@ def _use_pallas() -> bool:
         return False
 
 
+def _acc_dtype_of(gh_dtype):
+    """Accumulator dtype per gh row dtype: integer rows accumulate in
+    int32/int64 (ops/quantize.py overflow discipline), f64 stays f64,
+    anything else f32."""
+    if jnp.issubdtype(jnp.dtype(gh_dtype), jnp.integer):
+        from .quantize import acc_dtype
+        return acc_dtype(gh_dtype)
+    return jnp.float64 if gh_dtype == jnp.float64 else jnp.float32
+
+
 def _segment_histogram(bins: jnp.ndarray, gh: jnp.ndarray,
                        num_bins: int) -> jnp.ndarray:
     """Scatter-add formulation via a flat segment-sum — the direct
@@ -126,27 +178,38 @@ def _segment_histogram(bins: jnp.ndarray, gh: jnp.ndarray,
     ``ConstructHistogramInner``: per row, hist[bin] += (g, h)). On CPU
     this is ~20x less work than the one-hot contraction (O(S·F·C)
     updates vs O(S·F·B·C) FLOPs); on TPU the MXU prefers the matmul
-    forms, so this path is selected only for CPU backends."""
+    forms, so this path is selected only for CPU backends. Integer gh
+    accumulates int32/int64 — exact and order-invariant — and the int8
+    value stream is 4x fewer bytes than f32 through the bandwidth-bound
+    broadcast+scatter."""
     S, F = bins.shape
     C = gh.shape[1]
-    acc_dtype = (jnp.float64 if gh.dtype == jnp.float64
-                 else jnp.float32)
+    acc_dtype = _acc_dtype_of(gh.dtype)
     flat = (jnp.arange(F, dtype=jnp.int32)[None, :] * num_bins
             + bins.astype(jnp.int32)).reshape(-1)            # [S*F]
     vals = jnp.broadcast_to(
         gh.astype(acc_dtype)[:, None, :], (S, F, C)).reshape(-1, C)
     out = jax.ops.segment_sum(vals, flat, num_segments=F * num_bins)
-    return out.reshape(F, num_bins, C).astype(jnp.float32)
+    out = out.reshape(F, num_bins, C)
+    return out if jnp.issubdtype(acc_dtype, jnp.integer) \
+        else out.astype(jnp.float32)
 
 
 def _tile_histogram(bins_tile: jnp.ndarray, gh_tile: jnp.ndarray,
                     num_bins: int) -> jnp.ndarray:
     """[T, F] uint bins x [T, C] stats -> [F, B, C] partial histogram.
-    Accumulates in gh's dtype (f64 under tpu_use_f64_hist, else f32)."""
-    acc_dtype = (jnp.float64 if gh_tile.dtype == jnp.float64
-                 else jnp.float32)
+    Accumulates in gh's dtype family (f64 under tpu_use_f64_hist, else
+    f32; int32/int64 for quantized integer gh — the int8 x int8 one-hot
+    contraction is the MXU's native low-precision matmul shape)."""
+    acc_dtype = _acc_dtype_of(gh_tile.dtype)
     onehot = (bins_tile.astype(jnp.int32)[:, :, None]
               == jnp.arange(num_bins, dtype=jnp.int32)[None, None, :])
+    if jnp.issubdtype(acc_dtype, jnp.integer):
+        # exact in any precision; the one-hot factor rides the row dtype
+        # so the contraction stays int8/int16 into an int32/int64 sum
+        return jnp.einsum(
+            "tfb,tc->fbc", onehot.astype(gh_tile.dtype), gh_tile,
+            preferred_element_type=acc_dtype)
     return jnp.einsum(
         "tfb,tc->fbc", onehot.astype(acc_dtype), gh_tile,
         precision=jax.lax.Precision.HIGHEST,
@@ -165,7 +228,13 @@ def _hist_kernel_body(T: int, F: int, H: int, C: int, bins_ref, gh_ref,
     of the naive one-hot's N = C, and the one-hot factors never leave
     VMEM (the einsum fallback materializes S*F*B floats through HBM).
     Equivalent of the reference's shared-memory histogram kernels
-    (cuda_histogram_constructor.cu:18, ocl/histogram256.cl)."""
+    (cuda_histogram_constructor.cu:18, ocl/histogram256.cl).
+
+    The body is dtype-generic: quantized int8 gh rows contract as
+    int8 x int8 MXU matmuls into an int32 accumulator (the one-hot
+    factors and transients ride the 1-byte row dtype, which is what
+    lets the quantized caller run the 4x wider PALLAS_ROW_TILE_INT in
+    the same VMEM budget); f32 rows keep the f32 accumulator."""
     @_pl.when(_pl.program_id(0) == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
@@ -178,15 +247,18 @@ def _hist_kernel_body(T: int, F: int, H: int, C: int, bins_ref, gh_ref,
     lane_lo = (jax.lax.broadcasted_iota(jnp.int32, (1, 16 * C), 1)
                // C)                             # [1, 16*C]
     iota_h = jax.lax.broadcasted_iota(jnp.int32, (1, H), 1)
+    zero = jnp.zeros((), dtype=g.dtype)
+    acc_t = (jnp.int32 if jnp.issubdtype(g.dtype, jnp.integer)
+             else jnp.float32)
 
     def body(f, carry):
         hi_f = jax.lax.dynamic_slice(hi, (0, f), (T, 1))     # [T, 1]
         lo_f = jax.lax.dynamic_slice(lo, (0, f), (T, 1))
-        A = (hi_f == iota_h).astype(jnp.float32)             # [T, H]
-        W = jnp.where(lo_f == lane_lo, g_rep, 0.0)           # [T, 16C]
+        A = (hi_f == iota_h).astype(g.dtype)                 # [T, H]
+        W = jnp.where(lo_f == lane_lo, g_rep, zero)          # [T, 16C]
         acc = jax.lax.dot_general(
             A, W, dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)              # [H, 16C]
+            preferred_element_type=acc_t)                    # [H, 16C]
         out_ref[_pl.ds(f * H, H), :] += acc
         return carry
 
@@ -199,6 +271,11 @@ try:  # Pallas is TPU-only machinery; import lazily-tolerantly
 except Exception:  # pragma: no cover
     _pl = None
     _pltpu = None
+
+
+# int8 rows: 1-byte tiles/transients let 4x the rows sit in the same
+# VMEM working set as the f32 kernel's PALLAS_ROW_TILE
+PALLAS_ROW_TILE_INT = 4 * PALLAS_ROW_TILE
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
@@ -214,6 +291,8 @@ def _pallas_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
             [bins, jnp.zeros((pad, F), dtype=bins.dtype)])
         gh = jnp.concatenate([gh, jnp.zeros((pad, C), dtype=gh.dtype)])
     n_tiles = bins.shape[0] // T
+    quantized = jnp.issubdtype(gh.dtype, jnp.integer)
+    out_dtype = jnp.int32 if quantized else jnp.float32
     kernel = functools.partial(_hist_kernel_body, T, F, H, C)
     out = _pl.pallas_call(
         kernel,
@@ -223,7 +302,7 @@ def _pallas_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
             _pl.BlockSpec((T, C), lambda i: (i, 0)),
         ],
         out_specs=_pl.BlockSpec((F * H, 16 * C), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((F * H, 16 * C), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((F * H, 16 * C), out_dtype),
     )(bins, gh)
     # [F*H, 16*C] -> [F, H*16, C] -> [F, B, C]
     hist = out.reshape(F, H, 16, C).reshape(F, H * 16, C)
@@ -246,37 +325,51 @@ def build_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
         pass False — pallas_call has no SPMD partitioning rule, so GSPMD
         would all-gather the full bins array per device; the einsum path
         partitions cleanly and lets XLA insert the psum.
-    hist_impl : STATIC (backend, f64) from resolve_hist_impl — callers
-        thread it through their compiled-fn cache keys so a setting is
-        never baked stale into a cached trace.
+    hist_impl : STATIC (backend, f64[, quant_bits]) from
+        resolve_hist_impl — callers thread it through their compiled-fn
+        cache keys so a setting is never baked stale into a cached
+        trace.
 
-    Returns f32 [F, B, C].
+    Returns f32 [F, B, C] — or int32/int64 [F, B, C] when ``gh`` holds
+    quantized integer rows (ops/quantize.py): integer accumulation is
+    exact and order-invariant, and the caller dequantizes once per
+    split scan (ops/split.py).
     """
-    backend, f64 = hist_impl
+    backend, f64 = hist_impl[0], hist_impl[1]
     S, F = bins.shape
     C = gh.shape[1]
+    quantized = jnp.issubdtype(jnp.dtype(gh.dtype), jnp.integer)
+    if quantized:
+        f64 = False
+    # quantized Pallas: int8 rows only (the int16 mode's int64
+    # accumulator has no kernel variant; it takes the einsum path)
+    p_tile = PALLAS_ROW_TILE_INT if quantized else PALLAS_ROW_TILE
+    p_item = 1 if quantized else 4
     want_pallas = (pallas_ok and not f64
                    and backend not in ("onehot", "scatter")
-                   and S >= PALLAS_ROW_TILE and C <= 8
-                   and _pallas_fits(F, num_bins, C))
+                   and (not quantized or gh.dtype == jnp.int8)
+                   and S >= p_tile and C <= 8
+                   and _pallas_fits(F, num_bins, C, p_tile, p_item))
     if backend == "pallas" and not (want_pallas and _use_pallas()):
         # Explicit request could not be honored — say why (round-3
         # advisor: a silent downgrade skews kernel benchmarks).
         why = ("sharded-mesh caller" if not pallas_ok else
                "f64 histograms" if f64 else
-               "S=%d < %d row tile" % (S, PALLAS_ROW_TILE)
-               if S < PALLAS_ROW_TILE else
+               "int16 quantized rows (int64 accumulation)"
+               if quantized and gh.dtype != jnp.int8 else
+               "S=%d < %d row tile" % (S, p_tile)
+               if S < p_tile else
                "C=%d > 8 stat columns" % C if C > 8 else
                "VMEM bound (F=%d B=%d)" % (F, num_bins)
-               if not _pallas_fits(F, num_bins, C) else
+               if not _pallas_fits(F, num_bins, C, p_tile, p_item) else
                "no TPU backend / probe failed")
         _warn_once("hist_backend=pallas requested but unavailable here "
                    "(%s); using the einsum path" % why)
     if want_pallas and _use_pallas():
         if isinstance(bins, jax.core.Tracer):
-            return _pallas_histogram(bins, gh, num_bins, PALLAS_ROW_TILE)
+            return _pallas_histogram(bins, gh, num_bins, p_tile)
         try:  # concrete call: compile failures are catchable — degrade
-            return _pallas_histogram(bins, gh, num_bins, PALLAS_ROW_TILE)
+            return _pallas_histogram(bins, gh, num_bins, p_tile)
         except Exception as e:  # pragma: no cover - runtime-dependent
             _warn_once("Pallas histogram failed at shape F=%d B=%d (%s); "
                        "einsum fallback for this and later calls"
@@ -288,9 +381,10 @@ def build_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
     if backend == "scatter" or (backend == "auto"
                                 and jax.default_backend() == "cpu"):
         return _segment_histogram(bins, gh, num_bins)
-    acc_dtype = jnp.float64 if f64 else jnp.float32
+    acc_dtype = _acc_dtype_of(gh.dtype)
+    out_dtype = acc_dtype if quantized else jnp.float32
     if S <= row_tile:
-        return _tile_histogram(bins, gh, num_bins).astype(jnp.float32)
+        return _tile_histogram(bins, gh, num_bins).astype(out_dtype)
     # Pad S to a tile multiple; padded rows use gh = 0 so they vanish.
     pad = (-S) % row_tile
     if pad:
@@ -303,11 +397,12 @@ def build_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
 
     def step(acc, xs):
         b, g = xs
-        return acc + _tile_histogram(b, g, num_bins), None
+        return acc + _tile_histogram(b, g, num_bins).astype(acc.dtype), \
+            None
 
     init = jnp.zeros((F, num_bins, C), dtype=acc_dtype)
     hist, _ = jax.lax.scan(step, init, (bins_t, gh_t))
-    return hist.astype(jnp.float32)
+    return hist.astype(out_dtype)
 
 
 def subtract_histogram(parent: jnp.ndarray, child: jnp.ndarray) -> jnp.ndarray:
@@ -330,12 +425,17 @@ def unpack_bundle_histogram(bhist: jnp.ndarray,
     exclusivity means rows under other members' bins are zero rows of
     this feature.
 
-    totals : f32[C] — the leaf's (grad, hess, count, total) sums.
+    totals : [C] — the leaf's (grad, hess, count, total) sums, in the
+        histogram's own dtype (f32, or int32/int64 in quantized mode —
+        where the zero-bin residual reconstruction is EXACT integer
+        arithmetic instead of an f32 cancellation).
     """
     F = gidx_g.shape[0]
+    zero = jnp.zeros((), dtype=bhist.dtype)
     safe_g = jnp.maximum(gidx_g, 0)
     hist = bhist[safe_g, gidx_b]                       # [F, B, C]
-    hist = jnp.where((gidx_g >= 0)[..., None], hist, 0.0)
-    resid = totals[None, :] - jnp.sum(hist, axis=1)    # [F, C]
-    fix = jnp.where(zero_fix[:, None], resid, 0.0)
+    hist = jnp.where((gidx_g >= 0)[..., None], hist, zero)
+    resid = (totals.astype(bhist.dtype)[None, :]
+             - jnp.sum(hist, axis=1))                  # [F, C]
+    fix = jnp.where(zero_fix[:, None], resid, zero)
     return hist.at[jnp.arange(F), zero_bins].add(fix)

@@ -261,13 +261,22 @@ def find_best_split(hist: jnp.ndarray,
                     gain_penalty=None,
                     leaf_depth=None,
                     has_categorical: bool = True,
-                    bound_arrays=None) -> SplitInfo:
+                    bound_arrays=None,
+                    hist_scale=None) -> SplitInfo:
     """Scan a leaf histogram for the best (feature, threshold) pair.
 
     Parameters
     ----------
     hist : f32[F, B, 4] — per (feature, bin) sums of
-        (grad, hess, in-bag count, total count)
+        (grad, hess, in-bag count, total count). In quantized-gradient
+        mode this arrives as int32/int64 (exact integer accumulation,
+        ops/quantize.py) and is dequantized ONCE here — each bin sum
+        carries a single rounding from the scale multiply, however deep
+        the leaf, instead of the f32 path's one rounding per
+        accumulated row; the count channels convert exactly.
+    hist_scale : f32[2] (g_scale, h_scale) — required meaningful values
+        only when ``hist`` is integer; the leaf totals
+        (sum_grad/sum_hess/...) are passed already dequantized.
     sum_grad/sum_hess/sum_count/sum_total_count : leaf totals (f32 scalars)
     meta : FeatureMeta (i32[F] arrays)
     params : SplitParams scalars
@@ -292,6 +301,8 @@ def find_best_split(hist: jnp.ndarray,
       feature_histogram.hpp:950.
     """
     F, B, _ = hist.shape
+    from .quantize import dequantize_hist
+    hist = dequantize_hist(hist, hist_scale)
     g, h, c, tc = hist[..., 0], hist[..., 1], hist[..., 2], hist[..., 3]
     if min_output is None:
         min_output = jnp.float32(-jnp.inf)

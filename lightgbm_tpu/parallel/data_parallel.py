@@ -56,6 +56,7 @@ from ..obs import compile as obs_compile
 from ..obs.registry import registry as obs
 from ..ops.histogram import (build_histogram, subtract_histogram,
                              unpack_bundle_histogram)
+from ..ops.quantize import dequantize_sums, sum_gh
 from ..ops.split import (FeatureMeta, SplitParams, calculate_leaf_output,
                          find_best_split)
 from ..treelearner.capabilities import (CapabilityMixin, train_cegb,
@@ -173,9 +174,13 @@ class DataParallelTreeLearner(CapabilityMixin):
                                      self.rep_sharding)
         self._ff_rng = np.random.RandomState(config.feature_fraction_seed)
         from ..ops.histogram import resolve_hist_impl
+        qbits = (int(getattr(config, "quant_grad_bits", 8))
+                 if getattr(config, "use_quantized_grad", False) else 0)
         self._hist_impl = resolve_hist_impl(
             getattr(config, "hist_backend", "auto"),
-            bool(getattr(config, "tpu_use_f64_hist", False)))
+            bool(getattr(config, "tpu_use_f64_hist", False)), qbits)
+        self._init_quantization(self._hist_impl[2], config,
+                                cols_host.shape[0])
         self._has_cat = bool(
             np.asarray(self.meta.is_categorical).any())
         self._extra_trees = bool(config.extra_trees)
@@ -240,13 +245,21 @@ class DataParallelTreeLearner(CapabilityMixin):
         """Globally-summed per-feature [F, B, 4] histogram. Bundled:
         only the [G, Bg, 4] bundle histogram crosses devices, then the
         per-feature unpack runs replicated (``totals`` reconstructs the
-        zero-bin rows of bundled features, io/efb.py).
+        zero-bin rows of bundled features, io/efb.py). Quantized mode:
+        the local partials are int32 — the XLA-inserted cross-device
+        psum then moves HALF the bytes of the f32 histogram (and a
+        quarter on int8 gh rows vs f32 through the local pass).
 
         pallas_ok only on a 1-device mesh: pallas_call has no SPMD
         partitioning rule, so with real sharding GSPMD would all-gather
         the bins; unsharded, the kernel is safe (and is the fast path
         for single-chip tree_learner=data runs)."""
         p_ok = self.mesh.devices.size == 1
+        if jnp.issubdtype(gh.dtype, jnp.integer):
+            # callers hold dequantized f32 record totals; the bundled
+            # zero-bin fix needs the exact int sums of THESE (already
+            # masked) rows
+            totals = sum_gh(gh)
         if not self._bundled:
             h = build_histogram(bins, gh, self.B, pallas_ok=p_ok,
                                 hist_impl=self._hist_impl)
@@ -270,30 +283,32 @@ class DataParallelTreeLearner(CapabilityMixin):
                                        self.meta.zero_bin, totals)
 
     def _root_impl_opts(self, bins, gh, feature_mask, rand_seed,
-                        extra_trees: bool):
-        sums = jnp.sum(gh, axis=0)
-        hist = self._mesh_hist(bins, gh, sums)
+                        extra_trees: bool, qscale):
+        sums_raw = sum_gh(gh)
+        hist = self._mesh_hist(bins, gh, sums_raw)
+        sums = dequantize_sums(sums_raw, qscale)
         parent_out = calculate_leaf_output(sums[0], sums[1], self.params)
         info = find_best_split(
             hist, sums[0], sums[1], sums[2], sums[3], self.meta,
             self.params, feature_mask, parent_output=parent_out,
             rand_bins=_maybe_rand_bins(extra_trees, rand_seed, 0,
                                        self.meta, self.params),
-            leaf_depth=jnp.int32(0), has_categorical=self._has_cat)
+            leaf_depth=jnp.int32(0), has_categorical=self._has_cat,
+            hist_scale=qscale)
         leaf_of_row = self._initial_partition(gh)
         state = make_root_state(gh, hist, leaf_of_row, info, self.L,
                                 self.F, self.B, self._splittable(0),
                                 hist_slots=self._hist_slots)
         return state, _record_at(state, 0)
 
-    def _root_impl(self, bins, gh, feature_mask, rand_seed):
+    def _root_impl(self, bins, gh, feature_mask, rand_seed, qscale):
         return self._root_impl_opts(bins, gh, feature_mask, rand_seed,
-                                    self._extra_trees)
+                                    self._extra_trees, qscale)
 
     def _mesh_split_body(self, bins, state: GrowState, rec: SplitRecord,
                          leaf, new_leaf, valid, mask_left, mask_right,
                          rand_seed=0, extra_trees=None, pen_left=None,
-                         pen_right=None):
+                         pen_right=None, qscale=None):
         """Apply one chosen split and scan both children. ``valid``
         guards every state write (loop steps after the no-more-splits
         point must leave state untouched). The tail — depth gating, the
@@ -316,7 +331,7 @@ class DataParallelTreeLearner(CapabilityMixin):
         (hist_left, hist_right, mask_left,
          mask_right) = self._children_histograms(
             bins, state, rec, leaf, new_leaf, leaf_of_row,
-            smaller_is_left, mask_left, mask_right)
+            smaller_is_left, mask_left, mask_right, qscale)
         hists = self._update_hist_store(state, leaf, new_leaf, hist_left,
                                         hist_right, valid)
         state = state._replace(leaf_of_row=leaf_of_row, hists=hists)
@@ -327,19 +342,20 @@ class DataParallelTreeLearner(CapabilityMixin):
             extra_trees=(self._extra_trees if extra_trees is None
                          else extra_trees),
             has_cat=self._has_cat, rand_seed=rand_seed,
-            pen_left=pen_left, pen_right=pen_right)
+            pen_left=pen_left, pen_right=pen_right, qscale=qscale)
 
     def _children_histograms(self, bins, state, rec, leaf, new_leaf,
                              leaf_of_row, smaller_is_left, mask_left,
-                             mask_right):
+                             mask_right, qscale=None):
         """Cross-device-summed child histograms + the per-child scan
         masks. Base learner: masked histogram of the smaller child over
         the full sharded row space (the analogue of the reference ranks
         histogramming their local leaf rows then ReduceScatter-summing,
-        data_parallel_tree_learner.cpp:185), sibling by subtraction.
-        Voting-parallel overrides this with the reduced-comm vote."""
+        data_parallel_tree_learner.cpp:185), sibling by subtraction —
+        BIT-EXACT in quantized-integer mode. Voting-parallel overrides
+        this with the reduced-comm vote."""
         small_id = jnp.where(smaller_is_left, leaf, new_leaf)
-        small_mask = (leaf_of_row == small_id).astype(jnp.float32)
+        small_sel = leaf_of_row == small_id
         small_totals = jnp.stack([
             jnp.where(smaller_is_left, rec.left_sum_grad,
                       rec.right_sum_grad),
@@ -355,10 +371,14 @@ class DataParallelTreeLearner(CapabilityMixin):
             # data_partition.hpp:21; the CUDA learner's equivalent win
             # is cuda_data_partition's leaf-indexed row sets)
             hist_small = self._compact_child_hist(
-                bins, state.gh, leaf_of_row == small_id, small_totals)
+                bins, state.gh, small_sel, small_totals)
         else:
-            hist_small = self._mesh_hist(
-                bins, state.gh * small_mask[:, None], small_totals)
+            # dtype-preserving mask (an f32 multiply would de-quantize
+            # integer gh rows)
+            gh_masked = jnp.where(
+                small_sel[:, None], state.gh,
+                jnp.zeros((), dtype=state.gh.dtype))
+            hist_small = self._mesh_hist(bins, gh_masked, small_totals)
         hist_large = subtract_histogram(state.hists[leaf], hist_small)
         hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
         hist_right = jnp.where(smaller_is_left, hist_large, hist_small)
@@ -394,8 +414,9 @@ class DataParallelTreeLearner(CapabilityMixin):
                                                           mode="drop")
                 keep = (jnp.arange(S, dtype=jnp.int32)
                         < count)[:, None]
-                return self._mesh_hist(bins[idx],
-                                       gh[idx] * keep, totals)
+                gh_keep = jnp.where(keep, gh[idx],
+                                    jnp.zeros((), dtype=gh.dtype))
+                return self._mesh_hist(bins[idx], gh_keep, totals)
             return branch
 
         k = jnp.clip(
@@ -414,7 +435,8 @@ class DataParallelTreeLearner(CapabilityMixin):
                                         state.hists[new_leaf]))
 
     # ------------------------------------------------------------------
-    def _tree_impl(self, bins, state: GrowState, feature_mask, rand_seed):
+    def _tree_impl(self, bins, state: GrowState, feature_mask, rand_seed,
+                   qscale):
         """Grow the whole tree in one dispatch: while splits remain, the
         device argmaxes the next leaf (the argmax the reference reaches
         via SyncUpGlobalBestSplit), applies it, and appends the record.
@@ -437,7 +459,8 @@ class DataParallelTreeLearner(CapabilityMixin):
             state = self._mesh_split_body(bins, state, rec, best,
                                           new_leaf, valid, feature_mask,
                                           feature_mask,
-                                          rand_seed=rand_seed)
+                                          rand_seed=rand_seed,
+                                          qscale=qscale)
             return i + 1, state, recs, valid
 
         carry = (jnp.int32(0), state, _empty_records(kb, self.B),
@@ -446,7 +469,7 @@ class DataParallelTreeLearner(CapabilityMixin):
         return state, recs
 
     def _step_impl(self, bins, state: GrowState, leaf, new_leaf,
-                   mask_left, mask_right, rand_seed):
+                   mask_left, mask_right, rand_seed, qscale):
         """Single split step with a host-chosen leaf — the stepwise path
         used when per-split host state steers the scan (per-node feature
         masks; CEGB and intermediate monotone have their own variants)."""
@@ -454,14 +477,16 @@ class DataParallelTreeLearner(CapabilityMixin):
         valid = rec_valid(rec)
         state = self._mesh_split_body(bins, state, rec, leaf, new_leaf,
                                       valid, mask_left, mask_right,
-                                      rand_seed=rand_seed)
+                                      rand_seed=rand_seed, qscale=qscale)
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best)
 
     # --- CEGB (reference: cost_effective_gradient_boosting.hpp) -------
-    def _cegb_root_impl(self, bins, gh, feature_mask, used, fetched):
-        sums = jnp.sum(gh, axis=0)
-        hist = self._mesh_hist(bins, gh, sums)
+    def _cegb_root_impl(self, bins, gh, feature_mask, used, fetched,
+                        qscale):
+        sums_raw = sum_gh(gh)
+        hist = self._mesh_hist(bins, gh, sums_raw)
+        sums = dequantize_sums(sums_raw, qscale)
         parent_out = calculate_leaf_output(sums[0], sums[1], self.params)
         leaf_of_row = self._initial_partition(gh)
         if self._cegb_has_lazy:
@@ -475,14 +500,15 @@ class DataParallelTreeLearner(CapabilityMixin):
         info = find_best_split(
             hist, sums[0], sums[1], sums[2], sums[3], self.meta,
             self.params, feature_mask, parent_output=parent_out,
-            gain_penalty=pen, has_categorical=self._has_cat)
+            gain_penalty=pen, has_categorical=self._has_cat,
+            hist_scale=qscale)
         state = make_root_state(gh, hist, leaf_of_row, info, self.L,
                                 self.F, self.B, self._splittable(0),
                                 hist_slots=self._hist_slots)
         return state, _record_at(state, 0)
 
     def _cegb_step_impl(self, bins, state, leaf, new_leaf, feature_mask,
-                        used, fetched):
+                        used, fetched, qscale):
         """Mesh CEGB step (mirrors serial.py _cegb_step_fn_cached; the
         unfetched row sums reduce over the sharded row axis — XLA
         inserts the psum)."""
@@ -520,13 +546,13 @@ class DataParallelTreeLearner(CapabilityMixin):
         state = self._mesh_split_body(bins, state, rec, leaf, new_leaf,
                                       valid, feature_mask, feature_mask,
                                       extra_trees=False, pen_left=pen_l,
-                                      pen_right=pen_r)
+                                      pen_right=pen_r, qscale=qscale)
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), used2, fetched2
 
     # --- intermediate monotone (reference: monotone_constraints.hpp) --
     def _mono_step_impl(self, bins, state, leaf, new_leaf, feature_mask,
-                        lmin, lmax, rmin, rmax):
+                        lmin, lmax, rmin, rmax, qscale):
         """The children's output bounds come from the host tracker
         (sibling-output based, monotone_constraints.hpp:543) instead of
         the mid-point rule baked into the stored candidate."""
@@ -539,12 +565,12 @@ class DataParallelTreeLearner(CapabilityMixin):
         valid = rec_valid(rec)
         state = self._mesh_split_body(bins, state, rec, leaf, new_leaf,
                                       valid, feature_mask, feature_mask,
-                                      extra_trees=False)
+                                      extra_trees=False, qscale=qscale)
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), state.gain
 
     def _rescan_impl(self, state, leaf, sg, sh, c, tc, vmin, vmax, depth,
-                     allowed, feature_mask):
+                     allowed, feature_mask, qscale):
         """Recompute one leaf's candidate from its stored (replicated)
         histogram under tightened bounds (reference:
         SerialTreeLearner::RecomputeBestSplitForLeaf,
@@ -556,13 +582,14 @@ class DataParallelTreeLearner(CapabilityMixin):
                                self.params, feature_mask, vmin, vmax,
                                parent_output=parent_out,
                                leaf_depth=depth,
-                               has_categorical=self._has_cat)
+                               has_categorical=self._has_cat,
+                               hist_scale=qscale)
         state = _store_info(state, leaf, info, allowed)
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), state.gain
 
     def _adv_rescan_impl(self, state, leaf, sg, sh, c, tc, min_c, max_c,
-                         depth, allowed, feature_mask):
+                         depth, allowed, feature_mask, qscale):
         """monotone_constraints_method=advanced candidate scan — the
         per-(feature, bin) constraint arrays (replicated inputs) replace
         the leaf-wide pair (reference: AdvancedLeafConstraints,
@@ -576,7 +603,8 @@ class DataParallelTreeLearner(CapabilityMixin):
                                parent_output=parent_out,
                                leaf_depth=depth,
                                has_categorical=self._has_cat,
-                               bound_arrays=(min_c, max_c))
+                               bound_arrays=(min_c, max_c),
+                               hist_scale=qscale)
         state = _store_info(state, leaf, info, allowed)
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), state.gain
@@ -593,7 +621,7 @@ class DataParallelTreeLearner(CapabilityMixin):
             state, jnp.int32(leaf), jnp.float32(sg), jnp.float32(sh),
             jnp.float32(c), jnp.float32(tc), jnp.asarray(min_c),
             jnp.asarray(max_c), jnp.int32(depth), jnp.asarray(allowed),
-            feature_mask)
+            feature_mask, self._qscale)
 
     # --- adapter methods for the shared capability drivers ------------
     def _cegb_root(self, gh, feature_mask):
@@ -604,13 +632,15 @@ class DataParallelTreeLearner(CapabilityMixin):
                 "mesh.cegb_step", self._cegb_step_impl,
                 donate_argnums=(1,))
         return self._cegb_root_fn(self.bins, gh, feature_mask,
-                                  self._cegb_used, self._cegb_fetched)
+                                  self._cegb_used, self._cegb_fetched,
+                                  self._qscale)
 
     def _cegb_step(self, state, leaf, k, allowed, feature_mask, smaller):
         state, rec, self._cegb_used, self._cegb_fetched = \
             self._cegb_step_fn(self.bins, state, jnp.int32(leaf),
                                jnp.int32(k), feature_mask,
-                               self._cegb_used, self._cegb_fetched)
+                               self._cegb_used, self._cegb_fetched,
+                               self._qscale)
         return state, rec
 
     def _mono_root(self, gh, feature_mask, rand_seed):
@@ -619,10 +649,10 @@ class DataParallelTreeLearner(CapabilityMixin):
         # learner contract, _mono_root in treelearner/serial.py)
         if self._mono_root_fn is None:
             self._mono_root_fn = jax.jit(
-                lambda b, g, f, r: self._root_impl_opts(b, g, f, r,
-                                                        False))
+                lambda b, g, f, r, q: self._root_impl_opts(b, g, f, r,
+                                                           False, q))
         return self._mono_root_fn(self.bins, gh, feature_mask,
-                                  jnp.int32(rand_seed))
+                                  jnp.int32(rand_seed), self._qscale)
 
     def _mono_step(self, state, leaf, k, allowed, feature_mask, bounds,
                    smaller):
@@ -636,7 +666,8 @@ class DataParallelTreeLearner(CapabilityMixin):
         return self._mono_step_fn(
             self.bins, state, jnp.int32(leaf), jnp.int32(k), feature_mask,
             jnp.float32(bounds[0]), jnp.float32(bounds[1]),
-            jnp.float32(bounds[2]), jnp.float32(bounds[3]))
+            jnp.float32(bounds[2]), jnp.float32(bounds[3]),
+            self._qscale)
 
     def _mono_rescan(self, state, leaf, sums, entry, depth, allowed,
                      feature_mask):
@@ -645,7 +676,7 @@ class DataParallelTreeLearner(CapabilityMixin):
             state, jnp.int32(leaf), jnp.float32(sg), jnp.float32(sh),
             jnp.float32(c), jnp.float32(tc), jnp.float32(entry[0]),
             jnp.float32(entry[1]), jnp.int32(depth), jnp.asarray(allowed),
-            feature_mask)
+            feature_mask, self._qscale)
 
     def _node_step(self, state, leaf, k, allowed, mask_left, mask_right,
                    rand_seed, smaller):
@@ -655,7 +686,7 @@ class DataParallelTreeLearner(CapabilityMixin):
                 donate_argnums=(1,))
         return self._step_fn(self.bins, state, jnp.int32(leaf),
                              jnp.int32(k), mask_left, mask_right,
-                             jnp.int32(rand_seed))
+                             jnp.int32(rand_seed), self._qscale)
 
     # ------------------------------------------------------------------
     def _ensure_compiled(self):
@@ -680,6 +711,21 @@ class DataParallelTreeLearner(CapabilityMixin):
                 [gh, jnp.zeros((pad_n, 4), dtype=jnp.float32)], axis=0)
         return jax.device_put(gh, self.gh_sharding)
 
+    def _make_gh_quantized(self, grad, hess, bag):
+        """Quantized staging: discretize the UNPADDED [N] rows (the
+        padding-invariant draw shared with the serial learner,
+        capabilities.py _quantize_stage), then pad and shard the int
+        rows. Returns (gh int[R, 4] sharded, qscale f32[2] replicated)."""
+        ind = jnp.ones(self.N, dtype=jnp.float32) if bag is None else bag
+        gh, qscale = self._quantize_stage(grad, hess, ind,
+                                          self._tree_idx + 1)
+        pad_n = self.R - self.N
+        if pad_n:
+            gh = jnp.concatenate(
+                [gh, jnp.zeros((pad_n, 4), dtype=gh.dtype)], axis=0)
+        return (jax.device_put(gh, self.gh_sharding),
+                jax.device_put(qscale, self.rep_sharding))
+
     def _finalize_partition(self, leaf_of_row):
         return leaf_of_row[:self.N]
 
@@ -691,7 +737,12 @@ class DataParallelTreeLearner(CapabilityMixin):
         record buffer."""
         self._ensure_compiled()
         with obs.scope("tree::stage_gh"):
-            gh = self._make_gh(grad, hess, bag)
+            if self._quantized:
+                gh, self._qscale = self._make_gh_quantized(grad, hess,
+                                                           bag)
+            else:
+                gh = self._make_gh(grad, hess, bag)
+                self._qscale = self._qs_ones
             obs.watch_ready("tree::stage_gh", gh)
             feature_mask = self._sample_features()
 
@@ -708,7 +759,7 @@ class DataParallelTreeLearner(CapabilityMixin):
             return tree, self._finalize_partition(state.leaf_of_row)
         with obs.scope("tree::root_histogram"):
             state, rec = self._root_fn(self.bins, gh, feature_mask,
-                                       rand_seed)
+                                       rand_seed, self._qscale)
             obs.watch_ready("tree::root_histogram", rec)
         if self._needs_per_node_masks():
             state = train_stepwise(self, tree, state, rec, feature_mask,
@@ -719,7 +770,7 @@ class DataParallelTreeLearner(CapabilityMixin):
         # real device time
         with obs.scope("tree::split_batches"):
             state, recs = self._tree_fn(self.bins, state, feature_mask,
-                                        rand_seed)
+                                        rand_seed, self._qscale)
             recs_h = jax.device_get(recs)
         with obs.scope("tree::apply_records"):
             for i in range(self.L - 1):
@@ -750,6 +801,9 @@ class DataParallelTreeLearner(CapabilityMixin):
                 and not self._needs_per_node_masks()
                 and not self._extra_trees  # per-seed rand_bins break the
                 # partial-batch stop argument in GBDT.train_batch
+                and not self._quantized  # same reason: a post-stump step
+                # redraws the stochastic rounding and may grow a tree the
+                # host never applies
                 and not (0.0 < float(self.config.feature_fraction) < 1.0))
 
     def _make_gh_traced(self, grad, hess):
@@ -783,11 +837,15 @@ class DataParallelTreeLearner(CapabilityMixin):
 
     def _grow_one(self, bins, gh, feature_mask, seed, lr):
         """One tree inside the scan: root + whole-tree loop + leaf-output
-        replay. Returns (records, per-row output deltas [N])."""
+        replay. Returns (records, per-row output deltas [N]).
+        Exact-mode only (supports_train_many excludes quantized), so the
+        qscale passed is the constant ones."""
         barrier = jax.lax.optimization_barrier
-        state, _ = self._root_impl(bins, gh, feature_mask, seed)
+        state, _ = self._root_impl(bins, gh, feature_mask, seed,
+                                   self._qs_ones)
         state = barrier(state)
-        state, recs = self._tree_impl(bins, state, feature_mask, seed)
+        state, recs = self._tree_impl(bins, state, feature_mask, seed,
+                                      self._qs_ones)
         state, recs = barrier((state, recs))
         outs = self._leaf_outputs_from_records(recs) * lr
         return recs, outs[state.leaf_of_row[:self.N]]

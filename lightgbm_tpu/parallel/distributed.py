@@ -127,6 +127,19 @@ class DistributedDataParallelLearner(DataParallelTreeLearner):
 
         bins_local = self._init_mesh_common(config, local_dataset, mesh,
                                             axis)
+        if self._quantized:
+            # the per-iteration scale is a GLOBAL max and the stochastic
+            # draw is per-global-row; the host-side per-process staging
+            # has neither without an extra allgather round — quantized
+            # mode stays off the multi-process learner for now
+            from ..ops.histogram import _warn_once
+            _warn_once("use_quantized_grad is not supported by the "
+                       "multi-process distributed learner; training "
+                       "falls back to exact f32 histograms",
+                       component="parallel.distributed")
+            self._quantized = False
+            self._hist_impl = self._hist_impl[:2] + (0,)
+            self._qscale = self._qs_ones
         n_local, C = bins_local.shape
         if self.F == 0:
             log.fatal("Cannot train without features")

@@ -31,16 +31,19 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..io.dataset import BinnedDataset
 from ..ops.histogram import build_histogram
+from ..ops.quantize import dequantize_hist, dequantize_sums, sum_gh
 from ..ops.split import leaf_gain
 from .data_parallel import DataParallelTreeLearner
 
 
 def _per_feature_best_gain(hist, sum_grad, sum_hess, sum_count, meta,
-                           params, feature_mask):
+                           params, feature_mask, hist_scale=None):
     """Per-feature best split gain (the voting score): the numerical
     threshold scan reduced over bins only, no cross-feature argmax
     (reference: the local FindBestThreshold each rank runs before voting,
-    voting_parallel_tree_learner.cpp:243)."""
+    voting_parallel_tree_learner.cpp:243). Integer (quantized)
+    histograms dequantize here; the leaf sums arrive dequantized."""
+    hist = dequantize_hist(hist, hist_scale)
     g, h, c = hist[..., 0], hist[..., 1], hist[..., 2]
     left_g = jnp.cumsum(g, axis=1)
     left_h = jnp.cumsum(h, axis=1)
@@ -80,21 +83,23 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
         # back; keep a single hist slot instead of [L, F, B, 4]
         self._hist_slots = 1
 
-    def _voted_reduced_histogram(self, bins, gh_masked, feature_mask):
+    def _voted_reduced_histogram(self, bins, gh_masked, feature_mask,
+                                 qscale):
         """One child's globally-summed histogram, reduced only on voted
         features; returns ([F, B, 4] hist with unvoted rows zero,
-        bool[F] voted mask)."""
+        bool[F] voted mask). Quantized mode: the [V, B, 4] voted block
+        psums as int32 — half the f32 bytes on the wire."""
         mesh, axis = self.mesh, self.axis
         meta, params, B, F = self.meta, self.params, self.B, self.F
         k, V = self.top_k, self.n_voted
 
-        def local(bins_shard, gh_shard, fmask):
+        def local(bins_shard, gh_shard, fmask, qs):
             h = build_histogram(bins_shard, gh_shard, B,
                                 pallas_ok=False,
                                 hist_impl=self._hist_impl)  # local partial
-            s = jnp.sum(gh_shard, axis=0)                   # local sums
+            s = dequantize_sums(sum_gh(gh_shard), qs)       # local sums
             gains = _per_feature_best_gain(h, s[0], s[1], s[2], meta,
-                                           params, fmask)
+                                           params, fmask, hist_scale=qs)
             _, top_ids = jax.lax.top_k(gains, k)
             # a shard with no valid local split must not vote at all
             # (top_k on all--inf gains returns arbitrary low indices)
@@ -107,25 +112,30 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
             with jax.named_scope("obs_psum_voted_hist"):
                 hv = jax.lax.psum(h[voted], axis)           # [V, B, 4] — the
             #                                    reduced histogram traffic
-            full = jnp.zeros((F, B, 4), jnp.float32).at[voted].set(hv)
+            full = jnp.zeros((F, B, 4), hv.dtype).at[voted].set(hv)
             vmask = jnp.zeros(F, dtype=bool).at[voted].set(True)
             return full, vmask
 
         return shard_map(
             local, mesh=mesh,
-            in_specs=(P(axis, None), P(axis, None), P()),
-            out_specs=(P(), P()))(bins, gh_masked, feature_mask)
+            in_specs=(P(axis, None), P(axis, None), P(), P()),
+            out_specs=(P(), P()))(bins, gh_masked, feature_mask, qscale)
 
     def _children_histograms(self, bins, state, rec, leaf, new_leaf,
                              leaf_of_row, smaller_is_left, mask_left,
-                             mask_right):
+                             mask_right, qscale=None):
         left_id = leaf  # left child keeps the split leaf's id
-        mask_l = (leaf_of_row == left_id).astype(jnp.float32)
-        mask_r = (leaf_of_row == new_leaf).astype(jnp.float32)
+        if qscale is None:
+            qscale = self._qs_ones
+        zero = jnp.zeros((), dtype=state.gh.dtype)
+        gh_l = jnp.where((leaf_of_row == left_id)[:, None], state.gh,
+                         zero)
+        gh_r = jnp.where((leaf_of_row == new_leaf)[:, None], state.gh,
+                         zero)
         hist_left, voted_l = self._voted_reduced_histogram(
-            bins, state.gh * mask_l[:, None], mask_left)
+            bins, gh_l, mask_left, qscale)
         hist_right, voted_r = self._voted_reduced_histogram(
-            bins, state.gh * mask_r[:, None], mask_right)
+            bins, gh_r, mask_right, qscale)
         return (hist_left, hist_right, mask_left & voted_l,
                 mask_right & voted_r)
 

@@ -87,6 +87,42 @@ class CapabilityMixin:
                 or 0.0 < float(self.config.feature_fraction_bynode) < 1.0)
 
     # ------------------------------------------------------------------
+    def _init_quantization(self, qbits: int, config, max_rows: int
+                           ) -> None:
+        """Quantized-gradient mode state (ops/quantize.py), shared by
+        the serial and mesh learners: the static per-row magnitude cap
+        (overflow discipline vs the histogram accumulator), the row
+        dtype, and the per-tree PRNG seed for stochastic rounding.
+        ``self._qscale`` always holds the CURRENT tree's (g, h) scales —
+        ones in exact mode — so every step adapter can pass it
+        unconditionally."""
+        self._quantized = bool(qbits)
+        self._qs_ones = jnp.ones(2, dtype=jnp.float32)
+        self._qscale = self._qs_ones
+        if not self._quantized:
+            return
+        from ..ops.quantize import (effective_quant_max, quant_dtype,
+                                    quant_warn_capped)
+        self._qmax = effective_quant_max(qbits, max_rows)
+        self._qdtype = quant_dtype(qbits)
+        quant_warn_capped(qbits, self._qmax, max_rows)
+        self._quant_seed = int(getattr(config, "seed", 0)) & 0x7FFFFFFF
+
+    def _quantize_stage(self, grad, hess, ind, tree_no: int):
+        """Discretize one tree's (grad, hess, in-bag) to integer rows.
+        The draw runs on the UNPADDED [N] vectors with a per-tree
+        fold-in key, so learners with different row/feature padding
+        (serial pads rows to 4096s, meshes to the device count) produce
+        BIT-IDENTICAL quantized rows — the padding-invariance contract
+        make_rand_bins established for extra_trees."""
+        from ..ops.quantize import quantize_gh
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self._quant_seed),
+            jnp.uint32(tree_no & 0x7FFFFFFF))
+        return quantize_gh(grad, hess, ind, key, self._qmax,
+                           self._qdtype)
+
+    # ------------------------------------------------------------------
     def _make_cegb_fetched(self, rows: int) -> jnp.ndarray:
         """[rows, Fp] zeros for the lazy-penalty fetched matrix; mesh
         learners override to create it row-sharded."""

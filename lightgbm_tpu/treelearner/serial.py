@@ -55,6 +55,7 @@ from ..obs import compile as obs_compile
 from ..obs.registry import registry as obs
 from ..ops.histogram import (build_histogram, subtract_histogram,
                              unpack_bundle_histogram)
+from ..ops.quantize import dequantize_sums, sum_gh
 from ..ops.split import (FeatureMeta, SplitInfo, SplitParams,
                          calculate_leaf_output, find_best_split,
                          make_rand_bins)
@@ -202,7 +203,7 @@ def make_root_state(gh, hist, leaf_of_row, info, L: int, F: int, B: int,
     state = GrowState(
         leaf_of_row=leaf_of_row, gh=gh,
         hists=jnp.zeros((hist_slots, F, B, 4),
-                        dtype=jnp.float32).at[0].set(hist),
+                        dtype=hist.dtype).at[0].set(hist),
         leaf_depth=jnp.zeros(L, dtype=jnp.int32),
         gain=jnp.full(L, _NEG_INF, dtype=jnp.float32),
         feature=jnp.full(L, -1, dtype=jnp.int32),
@@ -319,12 +320,14 @@ def _leaf_histogram(bins, gh, meta, btab, *, B: int, Bg: int,
                     hist_impl: tuple = ("auto", False)):
     """Histogram of (a subset of) rows → per-feature [Fp, B, 4].
     Bundled mode histograms the [*, G] bundle matrix at Bg bins then
-    unpacks (totals = the leaf's channel sums for zero-bin rows)."""
+    unpacks (totals = the leaf's channel sums for zero-bin rows; must
+    match the histogram dtype — quantized integer gh recomputes the
+    exact int sums here when the caller only holds dequantized f32)."""
     if not bundled:
         return build_histogram(bins, gh, B, hist_impl=hist_impl)
     bhist = build_histogram(bins, gh, Bg, hist_impl=hist_impl)
-    if totals is None:
-        totals = jnp.sum(gh, axis=0)
+    if totals is None or jnp.issubdtype(gh.dtype, jnp.integer):
+        totals = sum_gh(gh)
     return unpack_bundle_histogram(bhist, btab.gidx_g, btab.gidx_b,
                                    btab.zero_fix, meta.zero_bin, totals)
 
@@ -370,7 +373,8 @@ def _finish_split(state: GrowState, rec: SplitRecord, leaf, new_leaf,
                   valid, hist_left, hist_right, mask_left, mask_right,
                   meta, params, *, max_depth: int, extra_trees: bool,
                   has_cat: bool, rand_seed=0, pen_left=None,
-                  pen_right=None, children_allowed=None) -> GrowState:
+                  pen_right=None, children_allowed=None,
+                  qscale=None) -> GrowState:
     """Depth gating + both children's best-split scans + candidate
     stores — the split-step tail shared verbatim by the serial and
     mesh-parallel learners (only the child-histogram computation
@@ -394,7 +398,7 @@ def _finish_split(state: GrowState, rec: SplitRecord, leaf, new_leaf,
         rand_bins=_maybe_rand_bins(extra_trees, rand_seed, 2 * new_leaf,
                                    meta, params),
         gain_penalty=pen_left, leaf_depth=child_depth,
-        has_categorical=has_cat)
+        has_categorical=has_cat, hist_scale=qscale)
     right_info = find_best_split(
         hist_right, rec.right_sum_grad, rec.right_sum_hess,
         rec.right_count, rec.right_total_count, meta, params,
@@ -404,7 +408,7 @@ def _finish_split(state: GrowState, rec: SplitRecord, leaf, new_leaf,
         rand_bins=_maybe_rand_bins(extra_trees, rand_seed,
                                    2 * new_leaf + 1, meta, params),
         gain_penalty=pen_right, leaf_depth=child_depth,
-        has_categorical=has_cat)
+        has_categorical=has_cat, hist_scale=qscale)
 
     state = state._replace(leaf_depth=leaf_depth)
     state = _store_info(state, leaf, left_info, children_allowed, valid)
@@ -418,7 +422,8 @@ def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
                 S: int, B: int, Bg: int, bundled: bool, max_depth: int,
                 extra_trees: bool, has_cat: bool = True,
                 hist_impl: tuple = ("auto", False), children_allowed=None,
-                rand_seed=0, pen_left=None, pen_right=None) -> GrowState:
+                rand_seed=0, pen_left=None, pen_right=None,
+                qscale=None) -> GrowState:
     """Apply one split (already chosen: ``rec`` at ``leaf``) and scan both
     children. Shared by the per-split and batched paths.
     ``children_allowed`` None means: derive from device leaf_depth."""
@@ -443,6 +448,9 @@ def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
         jnp.where(smaller_is_left, rec.left_count, rec.right_count),
         jnp.where(smaller_is_left, rec.left_total_count,
                   rec.right_total_count)])
+    # quantized mode: the record's totals are dequantized f32, but the
+    # bundled zero-bin fix needs exact int sums — _leaf_histogram
+    # recomputes them from the gathered integer rows
     hist_small = _leaf_histogram(bins[idx], state.gh[idx], meta, btab,
                                  B=B, Bg=Bg, bundled=bundled,
                                  totals=small_totals,
@@ -461,7 +469,8 @@ def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
                          max_depth=max_depth, extra_trees=extra_trees,
                          has_cat=has_cat, rand_seed=rand_seed,
                          pen_left=pen_left, pen_right=pen_right,
-                         children_allowed=children_allowed)
+                         children_allowed=children_allowed,
+                         qscale=qscale)
 
 
 @functools.lru_cache(maxsize=None)
@@ -469,12 +478,13 @@ def _root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
                     extra_trees: bool, has_cat: bool = True,
                     hist_impl: tuple = ("auto", False)):
     def root(bins, gh, leaf_of_row0, feature_mask, children_allowed,
-             rand_seed, meta, params, btab):
+             rand_seed, qscale, meta, params, btab):
         F = meta.num_bin.shape[0]
-        sums = jnp.sum(gh, axis=0)
+        sums_raw = sum_gh(gh)          # exact ints in quantized mode
         hist = _leaf_histogram(bins, gh, meta, btab, B=B, Bg=Bg,
-                               bundled=bundled, totals=sums,
+                               bundled=bundled, totals=sums_raw,
                                hist_impl=hist_impl)
+        sums = dequantize_sums(sums_raw, qscale)
         # root "parent" output: its own unsmoothed output (reference:
         # SerialTreeLearner::GetParentOutput, serial_tree_learner.cpp:786)
         parent_out = calculate_leaf_output(sums[0], sums[1], params)
@@ -483,7 +493,8 @@ def _root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
             feature_mask, parent_output=parent_out,
             rand_bins=_maybe_rand_bins(extra_trees, rand_seed, 0, meta,
                                        params),
-            leaf_depth=jnp.int32(0), has_categorical=has_cat)
+            leaf_depth=jnp.int32(0), has_categorical=has_cat,
+            hist_scale=qscale)
         state = make_root_state(gh, hist, leaf_of_row0, info, L, F, B,
                                 children_allowed)
         return state, _record_at(state, 0)
@@ -499,7 +510,8 @@ def _step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
     masks (interaction constraints / bynode sampling) force a host
     round-trip per split."""
     def step(bins, state: GrowState, leaf, new_leaf, children_allowed,
-             mask_left, mask_right, rand_seed, meta, params, btab):
+             mask_left, mask_right, rand_seed, qscale, meta, params,
+             btab):
         rec = _record_at(state, leaf)
         state = _split_body(bins, state, rec, leaf, new_leaf,
                             jnp.asarray(True), mask_left, mask_right,
@@ -508,7 +520,7 @@ def _step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
                             extra_trees=extra_trees, has_cat=has_cat,
                             hist_impl=hist_impl,
                             children_allowed=children_allowed,
-                            rand_seed=rand_seed)
+                            rand_seed=rand_seed, qscale=qscale)
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best)
 
@@ -533,12 +545,13 @@ def _cegb_root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
                          has_lazy: bool, has_cat: bool = True,
                          hist_impl: tuple = ("auto", False)):
     def root(bins, gh, leaf_of_row0, feature_mask, children_allowed,
-             used, fetched, coupled, lazy, meta, params, btab):
+             used, fetched, coupled, lazy, qscale, meta, params, btab):
         F = meta.num_bin.shape[0]
-        sums = jnp.sum(gh, axis=0)
+        sums_raw = sum_gh(gh)
         hist = _leaf_histogram(bins, gh, meta, btab, B=B, Bg=Bg,
-                               bundled=bundled, totals=sums,
+                               bundled=bundled, totals=sums_raw,
                                hist_impl=hist_impl)
+        sums = dequantize_sums(sums_raw, qscale)
         parent_out = calculate_leaf_output(sums[0], sums[1], params)
         if has_lazy:
             in_rows = (leaf_of_row0 >= 0).astype(jnp.float32)
@@ -550,7 +563,7 @@ def _cegb_root_fn_cached(L: int, B: int, Bg: int, bundled: bool,
         info = find_best_split(
             hist, sums[0], sums[1], sums[2], sums[3], meta, params,
             feature_mask, parent_output=parent_out, gain_penalty=pen,
-            has_categorical=has_cat)
+            has_categorical=has_cat, hist_scale=qscale)
         state = make_root_state(gh, hist, leaf_of_row0, info, L, F, B,
                                 children_allowed)
         return state, _record_at(state, 0)
@@ -571,8 +584,8 @@ def _cegb_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
     refunded when a coupled feature first becomes used — they keep the
     penalty until re-scanned as children (pessimistic ordering only)."""
     def step(bins, state: GrowState, leaf, new_leaf, children_allowed,
-             feature_mask, used, fetched, coupled, lazy, meta, params,
-             btab):
+             feature_mask, used, fetched, coupled, lazy, qscale, meta,
+             params, btab):
         rec = _record_at(state, leaf)
         f = jnp.maximum(rec.feature, 0)
         used2 = used.at[f].set(True)
@@ -610,7 +623,8 @@ def _cegb_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
                             extra_trees=False, has_cat=has_cat,
                             hist_impl=hist_impl,
                             children_allowed=children_allowed,
-                            pen_left=pen_l, pen_right=pen_r)
+                            pen_left=pen_l, pen_right=pen_r,
+                            qscale=qscale)
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), used2, fetched2
 
@@ -627,7 +641,8 @@ def _mono_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
     based, monotone_constraints.hpp:543) instead of the mid-point rule
     baked into the stored candidate."""
     def step(bins, state: GrowState, leaf, new_leaf, children_allowed,
-             feature_mask, lmin, lmax, rmin, rmax, meta, params, btab):
+             feature_mask, lmin, lmax, rmin, rmax, qscale, meta, params,
+             btab):
         state = state._replace(
             cand_left_min=state.cand_left_min.at[leaf].set(lmin),
             cand_left_max=state.cand_left_max.at[leaf].set(lmax),
@@ -640,7 +655,8 @@ def _mono_step_fn_cached(S: int, B: int, Bg: int, bundled: bool,
                             bundled=bundled, max_depth=0,
                             extra_trees=False, has_cat=has_cat,
                             hist_impl=hist_impl,
-                            children_allowed=children_allowed)
+                            children_allowed=children_allowed,
+                            qscale=qscale)
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), state.gain
 
@@ -655,7 +671,7 @@ def _rescan_fn_cached(B: int, has_cat: bool = True):
     SerialTreeLearner::RecomputeBestSplitForLeaf,
     serial_tree_learner.cpp:800)."""
     def rescan(state: GrowState, leaf, sg, sh, c, tc, vmin, vmax, depth,
-               allowed, feature_mask, meta, params, btab):
+               allowed, feature_mask, qscale, meta, params, btab):
         hist = state.hists[leaf]
         own = calculate_leaf_output(sg, sh, params)
         parent_out = jnp.where(params.path_smooth > 1e-10, own, 0.0)
@@ -663,7 +679,8 @@ def _rescan_fn_cached(B: int, has_cat: bool = True):
                                feature_mask, vmin, vmax,
                                parent_output=parent_out,
                                leaf_depth=depth,
-                               has_categorical=has_cat)
+                               has_categorical=has_cat,
+                               hist_scale=qscale)
         state = _store_info(state, leaf, info, allowed)
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), state.gain
@@ -680,7 +697,7 @@ def _adv_rescan_fn_cached(B: int, has_cat: bool = True):
     through CumulativeFeatureConstraint,
     monotone_constraints.hpp:856-1184 + feature_histogram.hpp:874-951)."""
     def rescan(state: GrowState, leaf, sg, sh, c, tc, min_c, max_c,
-               depth, allowed, feature_mask, meta, params, btab):
+               depth, allowed, feature_mask, qscale, meta, params, btab):
         hist = state.hists[leaf]
         own = calculate_leaf_output(sg, sh, params)
         parent_out = jnp.where(params.path_smooth > 1e-10, own, 0.0)
@@ -689,7 +706,8 @@ def _adv_rescan_fn_cached(B: int, has_cat: bool = True):
                                parent_output=parent_out,
                                leaf_depth=depth,
                                has_categorical=has_cat,
-                               bound_arrays=(min_c, max_c))
+                               bound_arrays=(min_c, max_c),
+                               hist_scale=qscale)
         state = _store_info(state, leaf, info, allowed)
         best = jnp.argmax(state.gain).astype(jnp.int32)
         return state, _record_at(state, best), state.gain
@@ -709,13 +727,13 @@ def _forced_fn_cached(S: int, B: int, Bg: int, bundled: bool,
     through the normal split body so the children get their candidate
     scans."""
     def forced(bins, state: GrowState, leaf, new_leaf, f, tbin,
-               children_allowed, feature_mask, rand_seed, meta, params,
-               btab):
+               children_allowed, feature_mask, rand_seed, qscale, meta,
+               params, btab):
         row = state.hists[leaf][f]                   # [B, 4]
-        cum = jnp.cumsum(row, axis=0)
+        cum = jnp.cumsum(row, axis=0)                # exact when integer
         tot = cum[-1]
-        left = cum[tbin]
-        right = tot - left
+        left = dequantize_sums(cum[tbin], qscale)
+        right = dequantize_sums(tot, qscale) - left
         out_l = calculate_leaf_output(left[0], left[1], params)
         out_r = calculate_leaf_output(right[0], right[1], params)
         # default_left must match where the cumsum put the missing rows:
@@ -743,7 +761,7 @@ def _forced_fn_cached(S: int, B: int, Bg: int, bundled: bool,
                             max_depth=0, extra_trees=extra_trees,
                             has_cat=has_cat, hist_impl=hist_impl,
                             children_allowed=children_allowed,
-                            rand_seed=rand_seed)
+                            rand_seed=rand_seed, qscale=qscale)
         return state, rec, ok
 
     return obs_compile.instrument_jit("serial.forced", forced,
@@ -760,7 +778,7 @@ def _batch_fn_cached(S: int, kb: int, B: int, Bg: int, bundled: bool,
     at serial_tree_learner.cpp:194). Records of the applied splits are
     written to [kb] buffers and read back once."""
     def batch(bins, state: GrowState, start_leaf, max_splits,
-              feature_mask, rand_seed, meta, params, btab):
+              feature_mask, rand_seed, qscale, meta, params, btab):
         def body(i, carry):
             state, recs = carry
             best = jnp.argmax(state.gain).astype(jnp.int32)
@@ -775,7 +793,7 @@ def _batch_fn_cached(S: int, kb: int, B: int, Bg: int, bundled: bool,
                                 max_depth=max_depth,
                                 extra_trees=extra_trees, has_cat=has_cat,
                                 hist_impl=hist_impl,
-                                rand_seed=rand_seed)
+                                rand_seed=rand_seed, qscale=qscale)
             return state, recs
 
         state, recs = jax.lax.fori_loop(
@@ -812,9 +830,12 @@ class SerialTreeLearner(CapabilityMixin):
         self.R = -(-(N + 1) // 4096) * 4096
         self.Fp = -(-F // 8) * 8
         from ..ops.histogram import resolve_hist_impl
+        qbits = (int(getattr(config, "quant_grad_bits", 8))
+                 if getattr(config, "use_quantized_grad", False) else 0)
         self._hist_impl = resolve_hist_impl(
             getattr(config, "hist_backend", "auto"),
-            bool(getattr(config, "tpu_use_f64_hist", False)))
+            bool(getattr(config, "tpu_use_f64_hist", False)), qbits)
+        self._init_quantization(self._hist_impl[2], config, N)
         self._bundled = dataset.bundle is not None
         ncols = (dataset.bundle.num_groups if self._bundled else F)
         self.Gp = -(-ncols // 8) * 8
@@ -961,8 +982,8 @@ class SerialTreeLearner(CapabilityMixin):
             state, rec, ok = fn(self.bins, state, jnp.int32(leaf),
                                 jnp.int32(next_leaf), jnp.int32(inner),
                                 jnp.int32(tbin), jnp.asarray(allowed),
-                                feature_mask, rand_seed, self.meta,
-                                self.params, self._btab)
+                                feature_mask, rand_seed, self._qscale,
+                                self.meta, self.params, self._btab)
             if not bool(jax.device_get(ok)):
                 log.warning("Forced split on feature %d leaves an empty "
                             "side; skipped" % int(spec["feature"]))
@@ -992,10 +1013,16 @@ class SerialTreeLearner(CapabilityMixin):
         with obs.scope("tree::stage_gh"):
             ind = jnp.ones(self.N, dtype=jnp.float32) if bag is None \
                 else bag
-            gh = jnp.stack([grad * ind, hess * ind, ind,
-                            jnp.ones(self.N, dtype=jnp.float32)], axis=1)
+            if self._quantized:
+                gh, self._qscale = self._quantize_stage(
+                    grad, hess, ind, self._tree_idx + 1)
+            else:
+                gh = jnp.stack([grad * ind, hess * ind, ind,
+                                jnp.ones(self.N, dtype=jnp.float32)],
+                               axis=1)
+                self._qscale = self._qs_ones
             gh = jnp.concatenate(
-                [gh, jnp.zeros((self.R - self.N, 4), dtype=jnp.float32)],
+                [gh, jnp.zeros((self.R - self.N, 4), dtype=gh.dtype)],
                 axis=0)
             # fencing mode blocks here so the staging cost lands in THIS
             # stage; sample/trace mode hands the output to the async
@@ -1018,8 +1045,8 @@ class SerialTreeLearner(CapabilityMixin):
         with obs.scope("tree::root_histogram"):
             state, rec = self._root_fn(self.bins, gh, self._leaf_of_row0,
                                        feature_mask, self._splittable(0),
-                                       rand_seed, self.meta, self.params,
-                                       self._btab)
+                                       rand_seed, self._qscale, self.meta,
+                                       self.params, self._btab)
             obs.watch_ready("tree::root_histogram", rec)
         leaf_total = {0: float(self.N)}
         next_leaf = 1
@@ -1055,8 +1082,8 @@ class SerialTreeLearner(CapabilityMixin):
             with obs.scope("tree::split_batches"):
                 state, recs = fn(self.bins, state, jnp.int32(next_leaf),
                                  jnp.int32(max_splits), feature_mask,
-                                 rand_seed, self.meta, self.params,
-                                 self._btab)
+                                 rand_seed, self._qscale, self.meta,
+                                 self.params, self._btab)
                 recs_h = jax.device_get(recs)
             stop = False
             with obs.scope("tree::apply_records"):
@@ -1084,7 +1111,8 @@ class SerialTreeLearner(CapabilityMixin):
         return root(self.bins, gh, self._leaf_of_row0, feature_mask,
                     self._splittable(0), self._cegb_used,
                     self._cegb_fetched, self._cegb_coupled,
-                    self._cegb_lazy, self.meta, self.params, self._btab)
+                    self._cegb_lazy, self._qscale, self.meta,
+                    self.params, self._btab)
 
     def _cegb_step(self, state, leaf, k, allowed, feature_mask, smaller):
         S = self._bucket(smaller)
@@ -1095,7 +1123,8 @@ class SerialTreeLearner(CapabilityMixin):
             self.bins, state, jnp.int32(leaf), jnp.int32(k),
             jnp.asarray(allowed), feature_mask,
             self._cegb_used, self._cegb_fetched, self._cegb_coupled,
-            self._cegb_lazy, self.meta, self.params, self._btab)
+            self._cegb_lazy, self._qscale, self.meta, self.params,
+            self._btab)
         return state, rec
 
     def _mono_root(self, gh, feature_mask, rand_seed):
@@ -1104,8 +1133,8 @@ class SerialTreeLearner(CapabilityMixin):
         root_fn = _root_fn_cached(self.L, self.B, self.Bg, self._bundled,
                                   False, self._has_cat, self._hist_impl)
         return root_fn(self.bins, gh, self._leaf_of_row0, feature_mask,
-                       self._splittable(0), rand_seed, self.meta,
-                       self.params, self._btab)
+                       self._splittable(0), rand_seed, self._qscale,
+                       self.meta, self.params, self._btab)
 
     def _mono_step(self, state, leaf, k, allowed, feature_mask, bounds,
                    smaller):
@@ -1116,7 +1145,7 @@ class SerialTreeLearner(CapabilityMixin):
                   jnp.asarray(allowed), feature_mask,
                   jnp.float32(bounds[0]), jnp.float32(bounds[1]),
                   jnp.float32(bounds[2]), jnp.float32(bounds[3]),
-                  self.meta, self.params, self._btab)
+                  self._qscale, self.meta, self.params, self._btab)
 
     def _mono_rescan(self, state, leaf, sums, entry, depth, allowed,
                      feature_mask):
@@ -1126,7 +1155,8 @@ class SerialTreeLearner(CapabilityMixin):
                       jnp.float32(sh), jnp.float32(c), jnp.float32(tc),
                       jnp.float32(entry[0]), jnp.float32(entry[1]),
                       jnp.int32(depth), jnp.asarray(allowed),
-                      feature_mask, self.meta, self.params, self._btab)
+                      feature_mask, self._qscale, self.meta, self.params,
+                      self._btab)
 
     def _adv_scan(self, state, leaf, sums, bound_arrays, depth, allowed,
                   feature_mask):
@@ -1137,7 +1167,7 @@ class SerialTreeLearner(CapabilityMixin):
                   jnp.float32(sh), jnp.float32(c), jnp.float32(tc),
                   jnp.asarray(min_c), jnp.asarray(max_c),
                   jnp.int32(depth), jnp.asarray(allowed), feature_mask,
-                  self.meta, self.params, self._btab)
+                  self._qscale, self.meta, self.params, self._btab)
 
     def _node_step(self, state, leaf, k, allowed, mask_left, mask_right,
                    rand_seed, smaller):
@@ -1145,4 +1175,4 @@ class SerialTreeLearner(CapabilityMixin):
         return self._step_fn(S)(
             self.bins, state, jnp.int32(leaf), jnp.int32(k),
             jnp.asarray(allowed), mask_left, mask_right, rand_seed,
-            self.meta, self.params, self._btab)
+            self._qscale, self.meta, self.params, self._btab)

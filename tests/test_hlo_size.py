@@ -49,6 +49,7 @@ def _root_args(lrn):
         _sds((lrn.Fp,), jnp.bool_),
         _sds((), jnp.bool_),
         _sds((), jnp.int32),
+        _sds((2,), jnp.float32),
         lrn.meta,
         lrn.params,
         lrn._btab,
@@ -71,6 +72,7 @@ def test_batch_step_hlo_small(learner):
     fn, _ = learner._batch_fn(S)
     lowered = fn.lower(args[0], state_sds, _sds((), jnp.int32),
                        _sds((), jnp.int32), args[3], _sds((), jnp.int32),
+                       _sds((2,), jnp.float32),
                        learner.meta, learner.params, learner._btab)
     n = _hlo_bytes(lowered)
     assert n < MAX_HLO_BYTES, f"batch step HLO is {n} bytes"
@@ -83,6 +85,7 @@ def test_stepwise_hlo_small(learner):
     lowered = fn.lower(args[0], state_sds, _sds((), jnp.int32),
                        _sds((), jnp.int32), _sds((), jnp.bool_),
                        args[3], args[3], _sds((), jnp.int32),
+                       _sds((2,), jnp.float32),
                        learner.meta, learner.params, learner._btab)
     n = _hlo_bytes(lowered)
     assert n < MAX_HLO_BYTES, f"stepwise HLO is {n} bytes"

@@ -109,10 +109,12 @@ class TestVotingParallel:
         bins_sds = jax.ShapeDtypeStruct(dist.bins.shape, dist.bins.dtype)
         mask_sds = jax.ShapeDtypeStruct((dist.F,), jnp.bool_)
         i32 = jax.ShapeDtypeStruct((), jnp.int32)
+        qs_sds = jax.ShapeDtypeStruct((2,), jnp.float32)
         state_sds, _ = jax.eval_shape(dist._root_impl, bins_sds, gh_sds,
-                                      mask_sds, i32)
+                                      mask_sds, i32, qs_sds)
         lowered = jax.jit(dist._step_impl).lower(
-            bins_sds, state_sds, i32, i32, mask_sds, mask_sds, i32)
+            bins_sds, state_sds, i32, i32, mask_sds, mask_sds, i32,
+            qs_sds)
         hlo = lowered.as_text()
         F, B, V = dist.F, dist.B, dist.n_voted
         # all-reduces over f32 histogram payloads: largest must be the

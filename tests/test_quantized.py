@@ -1,0 +1,358 @@
+"""Quantized-gradient histogram training (int8/int16 gh packing).
+
+Covers the ISSUE-4 tentpole contracts:
+- integer histogram accumulation is EXACT: matches an integer oracle
+  bit-for-bit, is order-invariant under row permutation, and sibling
+  subtraction is bit-exact (vs the f32 path's documented
+  accumulation-order drift);
+- quantized learners are padding-invariant: serial (rows padded to
+  4096s, features to 8s) and the mesh learners (device-count padding)
+  grow bit-identical trees;
+- end-to-end binary/multiclass smoke + AUC within 1e-3 of exact mode
+  on a Higgs-shaped sample;
+- backend downgrades are ASSERTABLE: every _warn_once message also
+  emits a ``perf_warning`` event through the events sink, so a silent
+  fallback fails tests instead of skewing benchmarks.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.obs import events as obs_events
+from lightgbm_tpu.ops.histogram import (_warn_once, build_histogram,
+                                        resolve_hist_impl,
+                                        subtract_histogram)
+from lightgbm_tpu.ops.quantize import (dequantize_sums,
+                                       effective_quant_max, quant_dtype,
+                                       quantize_gh, sum_gh)
+from lightgbm_tpu.parallel import (DataParallelTreeLearner,
+                                   VotingParallelTreeLearner, make_mesh)
+from lightgbm_tpu.treelearner.serial import SerialTreeLearner
+
+
+def _int_oracle(bins, gh, B):
+    S, F = bins.shape
+    C = gh.shape[1]
+    out = np.zeros((F, B, C), dtype=np.int64)
+    for f in range(F):
+        for c in range(C):
+            np.add.at(out[f, :, c], bins[:, f], gh[:, c].astype(np.int64))
+    return out
+
+
+def _quant_gh(S, seed=0, bits=8):
+    rng = np.random.RandomState(seed)
+    g = rng.randn(S).astype(np.float32)
+    h = np.abs(rng.randn(S)).astype(np.float32) + 0.05
+    ind = np.ones(S, dtype=np.float32)
+    qmax = effective_quant_max(bits, S)
+    gh, qscale = quantize_gh(jnp.asarray(g), jnp.asarray(h),
+                             jnp.asarray(ind), jax.random.PRNGKey(seed),
+                             qmax, quant_dtype(bits))
+    return np.asarray(gh), np.asarray(qscale), g, h
+
+
+@pytest.fixture
+def capture_events():
+    """Collect emitted events; resets the _warn_once dedup sets so
+    earlier tests' warnings do not swallow this test's assertions."""
+    seen = []
+    _warn_once._seen.clear()
+    _warn_once._emitted.clear()
+    obs_events.register_event_callback(seen.append)
+    yield seen
+    obs_events.register_event_callback(None)
+
+
+class TestQuantizeOps:
+    def test_stochastic_rounding_unbiased_and_bounded(self):
+        gh, qscale, g, h = _quant_gh(20000)
+        deq_g = gh[:, 0].astype(np.float64) * qscale[0]
+        deq_h = gh[:, 1].astype(np.float64) * qscale[1]
+        # per-row error bounded by one quantization step
+        assert np.max(np.abs(deq_g - g)) <= qscale[0] * (1 + 1e-6)
+        assert np.max(np.abs(deq_h - h)) <= qscale[1] * (1 + 1e-6)
+        # stochastic rounding is unbiased -> the mean survives
+        assert abs(deq_g.mean() - g.mean()) < 5e-4
+        assert abs(deq_h.mean() - h.mean()) < 5e-4
+        # count channels are exact
+        assert np.all(gh[:, 2] == 1) and np.all(gh[:, 3] == 1)
+
+    def test_int_histogram_matches_oracle_exactly(self):
+        rng = np.random.RandomState(1)
+        S, F, B = 3000, 5, 64
+        bins = rng.randint(0, B, size=(S, F)).astype(np.uint8)
+        gh, _, _, _ = _quant_gh(S, seed=1)
+        for impl in (resolve_hist_impl("auto", False, 8),
+                     resolve_hist_impl("onehot", False, 8),
+                     resolve_hist_impl("scatter", False, 8)):
+            hist = np.asarray(build_histogram(
+                jnp.asarray(bins), jnp.asarray(gh), B, hist_impl=impl))
+            assert np.issubdtype(hist.dtype, np.integer)
+            np.testing.assert_array_equal(
+                hist.astype(np.int64), _int_oracle(bins, gh, B))
+
+    def test_int_histogram_order_invariant(self):
+        """Row permutation changes the accumulation order; integer sums
+        must be BIT-identical (the f32 path only promises approximate
+        equality)."""
+        rng = np.random.RandomState(2)
+        S, F, B = 5000, 4, 128
+        bins = rng.randint(0, B, size=(S, F)).astype(np.uint8)
+        gh, _, _, _ = _quant_gh(S, seed=2)
+        perm = rng.permutation(S)
+        impl = resolve_hist_impl("auto", False, 8)
+        h1 = np.asarray(build_histogram(jnp.asarray(bins),
+                                        jnp.asarray(gh), B,
+                                        hist_impl=impl))
+        h2 = np.asarray(build_histogram(jnp.asarray(bins[perm]),
+                                        jnp.asarray(gh[perm]), B,
+                                        hist_impl=impl))
+        np.testing.assert_array_equal(h1, h2)
+
+    def test_subtract_histogram_bit_exact_int(self):
+        """parent − child == sibling EXACTLY in integer mode (the f32
+        subtraction trick drifts by accumulation-order rounding — the
+        reason hist-from-subtraction is a correctness WIN here)."""
+        rng = np.random.RandomState(3)
+        S, F, B = 4096, 6, 32
+        bins = rng.randint(0, B, size=(S, F)).astype(np.uint8)
+        gh, _, _, _ = _quant_gh(S, seed=3)
+        left = rng.rand(S) < 0.37
+        impl = resolve_hist_impl("auto", False, 8)
+
+        def hist_of(mask):
+            ghm = np.where(mask[:, None], gh, 0).astype(gh.dtype)
+            return np.asarray(build_histogram(
+                jnp.asarray(bins), jnp.asarray(ghm), B, hist_impl=impl))
+
+        parent = hist_of(np.ones(S, dtype=bool))
+        child = hist_of(left)
+        sibling = hist_of(~left)
+        got = np.asarray(subtract_histogram(jnp.asarray(parent),
+                                            jnp.asarray(child)))
+        np.testing.assert_array_equal(got, sibling)
+
+    def test_sum_and_dequantize(self):
+        gh, qscale, g, h = _quant_gh(8000, seed=4)
+        sums = sum_gh(jnp.asarray(gh))
+        assert jnp.issubdtype(sums.dtype, jnp.integer)
+        deq = np.asarray(dequantize_sums(sums, jnp.asarray(qscale)))
+        # the dequantized total carries ONE rounding; compare against
+        # the exact integer total times the scale
+        exact = gh[:, 0].astype(np.int64).sum() * float(qscale[0])
+        np.testing.assert_allclose(deq[0], exact, rtol=1e-6)
+        assert deq[2] == 8000.0 and deq[3] == 8000.0
+
+    def test_effective_quant_max_overflow_discipline(self):
+        # 8-bit: full range up to the int32 bound (127 * rows < 2^31,
+        # i.e. rows < ~16.9M — covers the 10.5M-row Higgs bench) ...
+        assert effective_quant_max(8, 10_500_000) == 127
+        # ... and capped beyond it: a one-sided channel CAN sum to
+        # qmax * rows, so silent int32 wraparound must be impossible
+        assert effective_quant_max(8, 1 << 25) == (2 ** 31 - 1) >> 25
+        assert effective_quant_max(8, 1 << 25) * (1 << 25) <= 2 ** 31 - 1
+        if not jax.config.jax_enable_x64:
+            # 16-bit under int32 accumulation: capped so qmax*rows fits
+            qm = effective_quant_max(16, 1 << 20)
+            assert qm == (2 ** 31 - 1) // (1 << 20)
+            assert qm * (1 << 20) <= 2 ** 31 - 1
+            # small data keeps the full 16-bit range
+            assert effective_quant_max(16, 4000) == 32767
+
+    def test_resolve_hist_impl_quant_triple(self):
+        assert resolve_hist_impl("auto", False, 8) == ("auto", False, 8)
+        assert resolve_hist_impl("auto")[2] == 0
+        # f64 + quantized resolve to the quantized mode
+        backend, f64, qbits = resolve_hist_impl("auto", True, 8)
+        assert (f64, qbits) == (False, 8)
+
+
+def _higgs_like(n, f=12, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float64)
+    X[:, ::4] = np.abs(X[:, ::4]) ** 1.5
+    w = rng.randn(f) * 0.6
+    logit = X @ w + 0.5 * np.sin(X[:, 0]) * X[:, 1]
+    y = (logit + rng.randn(n) * 0.5 > 0).astype(np.float64)
+    return X, y
+
+
+def _auc(y, score):
+    order = np.argsort(score, kind="mergesort")
+    rank = np.empty(len(y), dtype=np.float64)
+    rank[order] = np.arange(1, len(y) + 1)
+    pos = y > 0
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    return (rank[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def _assert_same_tree(t1, t2):
+    assert t1.num_leaves == t2.num_leaves
+    np.testing.assert_array_equal(t1.split_feature[:t1.num_internal],
+                                  t2.split_feature[:t2.num_internal])
+    np.testing.assert_array_equal(
+        t1.threshold_in_bin[:t1.num_internal],
+        t2.threshold_in_bin[:t2.num_internal])
+    np.testing.assert_allclose(t1.leaf_value[:t1.num_leaves],
+                               t2.leaf_value[:t2.num_leaves],
+                               rtol=2e-3, atol=1e-5)
+
+
+class TestQuantizedLearners:
+    def test_serial_matches_mesh_padding_invariance(self):
+        """The stochastic-rounding draw runs on the UNPADDED [N] rows
+        with a shared per-tree key, so serial (rows→4096s, features→8s)
+        and the mesh learners (rows→device count, unpadded features)
+        quantize identically — identical integer histograms — identical
+        trees. The quantized twin of the make_rand_bins invariance."""
+        rng = np.random.RandomState(0)
+        X = rng.randn(777, 6)
+        y = (X[:, 0] + 0.5 * X[:, 1] ** 2 > 0.3)
+        grad = np.where(y, -0.5, 0.5).astype(np.float32)
+        hess = np.full(777, 0.25, dtype=np.float32)
+        cfg = Config.from_params({"num_leaves": 15, "min_data_in_leaf": 5,
+                                  "use_quantized_grad": True,
+                                  "verbosity": -1})
+        ds = BinnedDataset.from_matrix(X, cfg)
+        mesh = make_mesh(8)
+        ts, ps = SerialTreeLearner(cfg, ds).train(
+            jnp.asarray(grad), jnp.asarray(hess))
+        td, pd = DataParallelTreeLearner(cfg, ds, mesh).train(
+            jnp.asarray(grad), jnp.asarray(hess))
+        cfg_v = Config.from_params({"num_leaves": 15,
+                                    "min_data_in_leaf": 5, "top_k": 6,
+                                    "use_quantized_grad": True,
+                                    "verbosity": -1})
+        tv, pv = VotingParallelTreeLearner(cfg_v, ds, mesh).train(
+            jnp.asarray(grad), jnp.asarray(hess))
+        for t, p in ((td, pd), (tv, pv)):
+            _assert_same_tree(ts, t)
+            np.testing.assert_array_equal(np.asarray(ps), np.asarray(p))
+
+    def test_binary_auc_within_1e3_of_exact(self, capture_events):
+        """Full-train AUC parity on a Higgs-shaped sample + no silent
+        backend fallback during the quantized run."""
+        X, y = _higgs_like(6000)
+        base = {"objective": "binary", "num_leaves": 31,
+                "min_data_in_leaf": 20, "learning_rate": 0.1,
+                "num_iterations": 15, "verbosity": -1}
+        aucs = {}
+        for mode in ("exact", "quant8", "quant16"):
+            params = dict(base)
+            if mode != "exact":
+                params["use_quantized_grad"] = True
+                params["quant_grad_bits"] = int(mode[-1:]
+                                                if mode == "quant8"
+                                                else 16)
+            bst = lgb.train(params, lgb.Dataset(X, label=y))
+            aucs[mode] = _auc(y, bst.predict(X, raw_score=True))
+        assert aucs["exact"] > 0.8  # the problem is learnable
+        assert abs(aucs["quant8"] - aucs["exact"]) <= 1e-3
+        assert abs(aucs["quant16"] - aucs["exact"]) <= 1e-3
+        warns = [e for e in capture_events
+                 if e["event"] == "perf_warning"]
+        assert warns == [], "silent backend fallback: %r" % warns
+
+    def test_multiclass_smoke(self):
+        rng = np.random.RandomState(5)
+        n = 1500
+        X = rng.randn(n, 6)
+        y = (np.argmax(X[:, :3] + 0.3 * rng.randn(n, 3), axis=1)
+             ).astype(np.float64)
+        params = {"objective": "multiclass", "num_class": 3,
+                  "num_leaves": 7, "num_iterations": 5,
+                  "use_quantized_grad": True, "verbosity": -1}
+        bst = lgb.train(params, lgb.Dataset(X, label=y))
+        pred = bst.predict(X)
+        assert pred.shape == (n, 3)
+        assert np.all(np.isfinite(pred))
+        np.testing.assert_allclose(pred.sum(axis=1), 1.0, rtol=1e-5)
+        acc = (np.argmax(pred, axis=1) == y).mean()
+        assert acc > 0.6
+
+    def test_quantized_efb_bundled(self):
+        """EFB bundle unpacking in integer mode: the zero-bin residual
+        reconstruction is exact int arithmetic. Mutually exclusive
+        one-hot blocks force bundling."""
+        rng = np.random.RandomState(6)
+        n = 1200
+        onehot = np.zeros((n, 6))
+        onehot[np.arange(n), rng.randint(0, 6, n)] = 1.0
+        dense = rng.randn(n, 2)
+        X = np.concatenate([dense, onehot], axis=1)
+        y = (X[:, 0] + onehot[:, 0] - onehot[:, 3]
+             + 0.3 * rng.randn(n) > 0).astype(np.float64)
+        params = {"objective": "binary", "num_leaves": 15,
+                  "num_iterations": 8, "min_data_in_leaf": 5,
+                  "verbosity": -1}
+        ds_train = lgb.Dataset(X, label=y)
+        exact = lgb.train(params, ds_train)
+        quant = lgb.train(dict(params, use_quantized_grad=True),
+                          lgb.Dataset(X, label=y))
+        # the exclusive block must actually have bundled
+        assert exact.inner.train_data.bundle is not None
+        a_e = _auc(y, exact.predict(X, raw_score=True))
+        a_q = _auc(y, quant.predict(X, raw_score=True))
+        assert a_q > 0.75 and abs(a_q - a_e) < 5e-3
+
+    def test_quantized_with_bagging_and_goss(self):
+        """The in-bag indicator rides the integer count channel; GOSS
+        amplification is folded into (grad, hess) before discretization."""
+        X, y = _higgs_like(3000, seed=7)
+        for extra in ({"bagging_fraction": 0.7, "bagging_freq": 1},
+                      {"data_sample_strategy": "goss"}):
+            params = {"objective": "binary", "num_leaves": 15,
+                      "num_iterations": 6, "use_quantized_grad": True,
+                      "verbosity": -1, **extra}
+            bst = lgb.train(params, lgb.Dataset(X, label=y))
+            assert _auc(y, bst.predict(X, raw_score=True)) > 0.75
+
+
+class TestWarnEvents:
+    def test_pallas_downgrade_emits_event(self, capture_events):
+        """hist_backend=pallas on a CPU backend must leave an
+        assertable perf_warning event, not only a (verbosity-gated)
+        log line."""
+        rng = np.random.RandomState(0)
+        bins = rng.randint(0, 16, size=(64, 2)).astype(np.uint8)
+        gh = np.ones((64, 4), dtype=np.float32)
+        build_histogram(jnp.asarray(bins), jnp.asarray(gh), 16,
+                        hist_impl=resolve_hist_impl("pallas"))
+        msgs = [e["message"] for e in capture_events
+                if e["event"] == "perf_warning"]
+        assert any("pallas" in m for m in msgs), msgs
+
+    def test_f64_under_quantization_emits_event(self, capture_events):
+        resolve_hist_impl("auto", True, 8)
+        msgs = [e["message"] for e in capture_events
+                if e["event"] == "perf_warning"]
+        assert any("tpu_use_f64_hist" in m for m in msgs), msgs
+
+    def test_warn_once_rearms_on_registry_reset(self, capture_events):
+        """registry.reset() clears the one-per-message dedup (the
+        obs/compile._WARNED pattern): the next run's fallback must emit
+        its own assertable event, not inherit the last run's
+        silence."""
+        from lightgbm_tpu.obs.registry import registry
+        resolve_hist_impl("auto", True, 8)
+        registry.reset()
+        resolve_hist_impl("auto", True, 8)
+        msgs = [e for e in capture_events
+                if e["event"] == "perf_warning"
+                and "tpu_use_f64_hist" in e["message"]]
+        assert len(msgs) == 2, msgs
+
+    @pytest.mark.skipif(jax.config.jax_enable_x64,
+                        reason="int64 accumulators lift the cap")
+    def test_16bit_cap_emits_event(self, capture_events):
+        from lightgbm_tpu.ops.quantize import quant_warn_capped
+        qm = effective_quant_max(16, 1 << 20)
+        quant_warn_capped(16, qm, 1 << 20)
+        msgs = [e["message"] for e in capture_events
+                if e["event"] == "perf_warning"]
+        assert any("quant_grad_bits=16 capped" in m for m in msgs), msgs

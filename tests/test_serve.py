@@ -349,3 +349,36 @@ def test_predict_server_backend_fallback_event(shared, tmp_path):
     finally:
         srv.stop()
     assert out == host[0]
+
+
+def test_deep_forest_device_sum_kahan_tight():
+    """ROADMAP open item: ``predict_raw_device`` accumulated plain f32
+    (~1e-5 rel error at 500 trees); the per-class Kahan-compensated sum
+    must land within ~1 ulp of the correctly rounded f64 total. 512
+    stump trees make the sum the ONLY source of error."""
+    from lightgbm_tpu.models.tree import Tree
+    rng = np.random.RandomState(0)
+    values = rng.rand(512).astype(np.float64)  # positive: no lucky
+    #                                            cancellation hides error
+    models = []
+    for v in values:
+        t = Tree(1)
+        t.leaf_value[0] = v
+        models.append(t)
+    forest = StackedForest(models, num_tree_per_iteration=1,
+                           num_features=1)
+    X = np.zeros((4, 1), dtype=np.float32)
+    dev = np.asarray(forest.predict_raw_device(X))[:, 0]
+    exact = values.sum()  # f64 reference (the host predict_raw contract)
+    naive = np.float32(0.0)
+    for v in values.astype(np.float32):
+        naive += v
+    kahan_err = abs(float(dev[0]) - exact)
+    # at most ~2 ulp of the f32 result (vs ~sqrt(T)/2 ulp for the
+    # plain running sum)
+    ulp = np.spacing(np.float32(exact))
+    assert kahan_err <= 2 * float(ulp), (kahan_err, float(ulp))
+    # and never worse than the plain f32 running sum it replaced
+    assert kahan_err <= abs(float(naive) - exact) + 1e-12
+    # all rows identical (stumps ignore features)
+    np.testing.assert_array_equal(dev, dev[0])

@@ -113,6 +113,16 @@ def test_trace_roundtrip_covers_pipeline_and_costs(tmp_path):
     # every span carries the process trace id
     tids = {e["args"].get("trace_id") for e in _spans(doc)}
     assert len(tids) == 1 and None not in tids
+    # roofline surfacing (ISSUE 4 satellite): the summary aggregates
+    # per-fn FLOPs + bytes-accessed from the compile spans into a
+    # bytes/FLOP ratio — the direct evidence of a program's bandwidth
+    # position (and of the quantized path moving fewer bytes)
+    summary = trace_report.summarize(doc)
+    roof = summary.get("roofline", {})
+    assert roof, "no roofline section despite costed jit spans"
+    costed = [r for r in roof.values() if r["flops"] > 0]
+    assert costed
+    assert any(r.get("bytes_per_flop") is not None for r in costed)
 
 
 def test_trace_report_validate_cli_smoke(tmp_path):

@@ -91,9 +91,11 @@ def main() -> None:
                     lambda: learner._make_gh(grad, hess, None))
         feature_mask = learner._sample_features()
         state, root_rec = fenced("phase::root_fn", lambda: learner._root_fn(
-            learner.bins, gh, feature_mask, jnp.int32(k + 1)))
+            learner.bins, gh, feature_mask, jnp.int32(k + 1),
+            learner._qscale))
         state, recs = fenced("phase::tree_fn", lambda: learner._tree_fn(
-            learner.bins, state, feature_mask, jnp.int32(k + 1)))
+            learner.bins, state, feature_mask, jnp.int32(k + 1),
+            learner._qscale))
         with registry.scope("phase::readback"):
             jax.device_get(recs)
 

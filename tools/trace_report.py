@@ -211,10 +211,27 @@ def merge_traces(paths: List[str]) -> dict:
 
 
 def summarize(doc: dict) -> dict:
-    """Aggregate spans into the BENCH-shaped stage table."""
+    """Aggregate spans into the BENCH-shaped stage table, plus a
+    roofline section built from the compile spans' cost_analysis args
+    (obs/compile.py captures FLOPs + bytes-accessed per jitted
+    function): bytes_per_flop places each program on the roofline —
+    high ratios are bandwidth-bound, which is the direct way to SEE the
+    quantized histogram path moving fewer bytes than the exact one."""
     per_stage: Dict[str, List[float]] = {}
+    roofline: Dict[str, dict] = {}
     for e in _spans(doc):
         per_stage.setdefault(e["name"], []).append(e["dur"] / 1e6)
+        args = e.get("args") or {}
+        if e.get("cat") == "compile" and "flops" in args:
+            fn = args.get("fn", e["name"])
+            r = roofline.setdefault(
+                fn, {"flops": 0.0, "bytes_accessed": 0.0, "compiles": 0})
+            r["flops"] += float(args.get("flops", 0.0))
+            r["bytes_accessed"] += float(args.get("bytes_accessed", 0.0))
+            r["compiles"] += 1
+    for fn, r in roofline.items():
+        r["bytes_per_flop"] = (round(r["bytes_accessed"] / r["flops"], 6)
+                               if r["flops"] > 0 else None)
     phases = {}
     for name, durs in sorted(per_stage.items()):
         sv = sorted(durs)
@@ -224,7 +241,10 @@ def summarize(doc: dict) -> dict:
             "p50_ms": round(_percentile(sv, 50) * 1e3, 3),
             "p99_ms": round(_percentile(sv, 99) * 1e3, 3),
         }
-    return {"phases": phases}
+    out = {"phases": phases}
+    if roofline:
+        out["roofline"] = dict(sorted(roofline.items()))
+    return out
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
