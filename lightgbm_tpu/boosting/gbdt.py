@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import Config
+from ..obs import compile as obs_compile
 from ..obs import events as obs_events
 from ..obs import health as obs_health
 from ..obs import trace as obs_trace
@@ -45,10 +46,28 @@ from ..models.tree import Tree
 from ..objective import ObjectiveFunction, create_objective
 from ..treelearner import create_tree_learner
 from ..utils import log
+from ..utils.scalars import dev_i32
 from .sample_strategy import create_sample_strategy
 
 kEpsilon = 1e-15
 _K_MIN_SCORE = -np.inf
+
+# Per-iteration score plumbing, jitted so the hot loop performs no
+# implicit host-to-device transfers (eager slices / .at updates turn
+# their index scalars into device buffers on every call; the
+# transfer_guard sanitizer in tests/test_jaxlint.py pins this). The
+# class index is a TRACED scalar (utils/scalars.dev_i32), so one
+# compile serves every class — a static index would compile per class
+# and trip the retrace warning past 32 classes.
+_take_col = obs_compile.instrument_jit(
+    "gbdt.take_col", lambda m, k: m[:, k])
+_apply_leaf_delta = obs_compile.instrument_jit(
+    "gbdt.score_delta",
+    lambda score, leaf_values, leaf_of_row, k:
+        score.at[:, k].add(leaf_values[leaf_of_row]))
+_add_score_col = obs_compile.instrument_jit(
+    "gbdt.score_add_col",
+    lambda score, delta, k: score.at[:, k].add(delta))
 
 
 def run_instrumented_eval(iter_idx: int, compute):
@@ -328,8 +347,12 @@ class GBDT:
                     log.fatal("No objective function provided")
                 for k in range(K):
                     init_scores[k] = self._boost_from_average(k)
-                score = self.train_score[:, 0] if K == 1 \
-                    else self.train_score
+                # jitted column view: an eager [:, 0] slice performs an
+                # implicit scalar transfer per iteration (the slice
+                # start indices become device buffers) — the sanitizer
+                # test pins this loop transfer-free
+                score = _take_col(self.train_score, dev_i32(0)) \
+                    if K == 1 else self.train_score
                 g, h = self.objective.get_gradients(score)
             else:
                 g = jnp.asarray(np.asarray(grad, dtype=np.float32))
@@ -348,8 +371,11 @@ class GBDT:
         should_continue = False
         new_trees = []
         for k in range(K):
-            gk = g if K == 1 else g[:, k]
-            hk = h if K == 1 else h[:, k]
+            # jitted per-class column gather (traced k: one compile
+            # serves all classes; eager slicing would transfer the
+            # slice indices per class per iteration)
+            gk = g if K == 1 else _take_col(g, dev_i32(k))
+            hk = h if K == 1 else _take_col(h, dev_i32(k))
             tree: Optional[Tree] = None
             if self.class_need_train[k] and self.train_data.num_features > 0:
                 with obs.scope("tree::grow"):
@@ -492,6 +518,8 @@ class GBDT:
             score_t, recs = learner.train_many(
                 self.objective.get_gradients, score0, seeds,
                 self.shrinkage_rate)
+            # jaxlint: disable=JLT001 -- the batch's single deliberate
+            # sync: n_iters trees' split records read back in one hop
             recs_h = jax.device_get(recs)
         t_dispatch = time.perf_counter() - t_batch0
         kb = max(learner.L - 1, 1)
@@ -574,12 +602,20 @@ class GBDT:
             delta = jnp.asarray(linear_predict(
                 tree, self.train_data.raw_data,
                 np.asarray(leaf_of_row)).astype(np.float32))
+            self.train_score = _add_score_col(
+                self.train_score, delta, dev_i32(class_id))
         else:
-            leaf_values = jnp.asarray(
-                tree.leaf_value[:max(tree.num_leaves, 1)].astype(
-                    np.float32))
-            delta = leaf_values[leaf_of_row]
-        self.train_score = self.train_score.at[:, class_id].add(delta)
+            # leaf values padded to the configured num_leaves so every
+            # tree shares ONE compiled gather+add per class (a
+            # tree-sized vector would retrace per leaf count); the
+            # jnp.asarray transfer is the explicit per-tree host→device
+            # hop of the new leaf outputs
+            L = max(int(self.config.num_leaves), tree.num_leaves, 1)
+            lv = np.zeros(L, dtype=np.float32)
+            lv[:tree.num_leaves] = tree.leaf_value[:tree.num_leaves]
+            self.train_score = _apply_leaf_delta(
+                self.train_score, jnp.asarray(lv), leaf_of_row,
+                dev_i32(class_id))
         for vd in self.valid_data:
             vd.add_tree(tree, class_id, self._bin_meta)
 

@@ -12,7 +12,6 @@ masked-row trick the CUDA learner's bagging path uses.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -20,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils import log
+from ..obs import compile as obs_compile
 
 
 class SampleStrategy:
@@ -96,7 +96,7 @@ class GOSSStrategy(SampleStrategy):
         self.top_k = max(1, int(num_data * config.top_rate))
         self.other_k = max(1, int(num_data * config.other_rate))
 
-    @partial(jax.jit, static_argnums=0)
+    @obs_compile.instrument_jit_method("boost.goss")
     def _goss(self, grad, hess, key):
         # grad/hess: [N] or [N, K]
         g2 = jnp.abs(grad * hess)

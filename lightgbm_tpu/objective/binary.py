@@ -6,14 +6,13 @@ src/objective/cuda/cuda_binary_objective.cpp).
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..utils import log
+from ..obs import compile as obs_compile
 from .base import ObjectiveFunction
 
 _EPS = 1e-12
@@ -73,7 +72,7 @@ class BinaryLogloss(ObjectiveFunction):
             np.where(is_pos, pos_weight, neg_weight).astype(np.float32))
         self._is_pos_np = is_pos
 
-    @partial(jax.jit, static_argnums=0)
+    @obs_compile.instrument_jit_method("obj.binary.grads")
     def _grads(self, score, label_sign, label_weight, weights):
         response = (-label_sign * self.sigmoid
                     / (1.0 + jnp.exp(label_sign * self.sigmoid * score)))

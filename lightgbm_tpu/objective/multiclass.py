@@ -8,7 +8,6 @@ with a rescaling factor K/(K-1), :31,101-105).
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import List
 
 import jax
@@ -16,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils import log
+from ..obs import compile as obs_compile
 from .base import ObjectiveFunction
 from .binary import BinaryLogloss
 
@@ -52,7 +52,7 @@ class MulticlassSoftmax(ObjectiveFunction):
         self.label_onehot = jnp.asarray(
             np.eye(self.num_class, dtype=np.float32)[li])
 
-    @partial(jax.jit, static_argnums=0)
+    @obs_compile.instrument_jit_method("obj.multiclass.grads")
     def _grads(self, score, label_onehot, weights):
         p = jax.nn.softmax(score, axis=1)
         grad = p - label_onehot

@@ -14,7 +14,6 @@ exp directly.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
@@ -22,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils import log
+from ..obs import compile as obs_compile
 from . import dcg
 from .base import ObjectiveFunction
 
@@ -163,7 +163,7 @@ class LambdarankNDCG(ObjectiveFunction):
             hessians = hessians * norm_factor
         return lambdas, hessians
 
-    @partial(jax.jit, static_argnums=0)
+    @obs_compile.instrument_jit_method("obj.lambdarank.grads")
     def _grads(self, score, labels_pad, doc_idx, mask, inv_max_dcgs, weights):
         N = score.shape[0]
         score_pad = jnp.concatenate([score, jnp.zeros((1,), score.dtype)])
@@ -247,7 +247,7 @@ class RankXENDCG(ObjectiveFunction):
         ok = mask & (cnt > 1)
         return jnp.where(ok, lambdas, 0.0), jnp.where(ok, hessians, 0.0)
 
-    @partial(jax.jit, static_argnums=0)
+    @obs_compile.instrument_jit_method("obj.xendcg.grads")
     def _grads(self, score, labels_pad, doc_idx, mask, key, weights):
         N = score.shape[0]
         score_pad = jnp.concatenate([score, jnp.zeros((1,), score.dtype)])

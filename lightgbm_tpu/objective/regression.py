@@ -9,13 +9,12 @@ device-resident gradient buffers.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import compile as obs_compile
 from ..utils import log
 from .base import ObjectiveFunction, weighted_percentile
 
@@ -50,7 +49,7 @@ class RegressionL2(ObjectiveFunction):
             trans = np.sign(raw) * np.sqrt(np.abs(raw))
             self.label = jnp.asarray(trans.astype(np.float32))
 
-    @partial(jax.jit, static_argnums=0)
+    @obs_compile.instrument_jit_method("obj.regression_l2.grads")
     def _grads(self, score, label, weights):
         grad = score - label
         hess = jnp.ones_like(score)
@@ -88,7 +87,7 @@ class RegressionL1(RegressionL2):
     def is_constant_hessian(self) -> bool:
         return self.weights is None
 
-    @partial(jax.jit, static_argnums=0)
+    @obs_compile.instrument_jit_method("obj.regression_l1.grads")
     def _grads(self, score, label, weights):
         grad = jnp.sign(score - label)
         hess = jnp.ones_like(score)
@@ -141,7 +140,7 @@ class RegressionHuber(RegressionL2):
         if self.alpha <= 0.0:
             log.fatal("alpha should be greater than 0")
 
-    @partial(jax.jit, static_argnums=0)
+    @obs_compile.instrument_jit_method("obj.huber.grads")
     def _grads(self, score, label, weights):
         diff = score - label
         grad = jnp.clip(diff, -self.alpha, self.alpha)
@@ -165,7 +164,7 @@ class RegressionFair(RegressionL2):
     def is_constant_hessian(self) -> bool:
         return False
 
-    @partial(jax.jit, static_argnums=0)
+    @obs_compile.instrument_jit_method("obj.fair.grads")
     def _grads(self, score, label, weights):
         x = score - label
         denom = jnp.abs(x) + self.c
@@ -202,7 +201,7 @@ class RegressionPoisson(RegressionL2):
     def is_constant_hessian(self) -> bool:
         return False
 
-    @partial(jax.jit, static_argnums=0)
+    @obs_compile.instrument_jit_method("obj.poisson.grads")
     def _grads(self, score, label, weights):
         exp_score = jnp.exp(score)
         grad = exp_score - label
@@ -236,7 +235,7 @@ class RegressionQuantile(RegressionL2):
     def is_constant_hessian(self) -> bool:
         return self.weights is None
 
-    @partial(jax.jit, static_argnums=0)
+    @obs_compile.instrument_jit_method("obj.quantile.grads")
     def _grads(self, score, label, weights):
         grad = jnp.where(score > label, 1.0 - self.alpha, -self.alpha)
         hess = jnp.ones_like(score)
@@ -299,7 +298,7 @@ class RegressionMAPE(RegressionL1):
         return self._grads_mape(score, self.label, self.label_weight,
                                 self.weights)
 
-    @partial(jax.jit, static_argnums=0)
+    @obs_compile.instrument_jit_method("obj.mape.grads")
     def _grads_mape(self, score, label, label_weight, weights):
         grad = jnp.sign(score - label) * label_weight
         hess = (jnp.ones_like(score) if weights is None else weights)
@@ -320,7 +319,7 @@ class RegressionGamma(RegressionPoisson):
 
     name = "gamma"
 
-    @partial(jax.jit, static_argnums=0)
+    @obs_compile.instrument_jit_method("obj.gamma.grads")
     def _grads(self, score, label, weights):
         exp_ns = jnp.exp(-score)
         grad = 1.0 - label * exp_ns
@@ -343,7 +342,7 @@ class RegressionTweedie(RegressionPoisson):
         if (label < 0).any():
             log.fatal("[%s]: at least one target label is negative" % self.name)
 
-    @partial(jax.jit, static_argnums=0)
+    @obs_compile.instrument_jit_method("obj.tweedie.grads")
     def _grads(self, score, label, weights):
         exp_1 = jnp.exp((1.0 - self.rho) * score)
         exp_2 = jnp.exp((2.0 - self.rho) * score)

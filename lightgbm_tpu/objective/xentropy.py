@@ -5,13 +5,13 @@ CrossEntropyLambda (reference: src/objective/xentropy_objective.hpp:21,148).
 """
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..utils import log
+from ..obs import compile as obs_compile
 from .base import ObjectiveFunction
 
 _EPS = 1e-12
@@ -39,7 +39,7 @@ class CrossEntropy(ObjectiveFunction):
             if w.sum() == 0.0:
                 log.fatal("[%s]: sum of weights is zero" % self.name)
 
-    @partial(jax.jit, static_argnums=0)
+    @obs_compile.instrument_jit_method("obj.xentropy.grads")
     def _grads(self, score, label, weights):
         z = jax.nn.sigmoid(score)
         grad = z - label
@@ -88,7 +88,7 @@ class CrossEntropyLambda(ObjectiveFunction):
                 log.fatal("[%s]: at least one weight is non-positive"
                           % self.name)
 
-    @partial(jax.jit, static_argnums=0)
+    @obs_compile.instrument_jit_method("obj.xentropy_lambda.grads")
     def _grads(self, score, label, weights):
         if weights is None:
             z = jax.nn.sigmoid(score)

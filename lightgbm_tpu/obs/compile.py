@@ -259,6 +259,23 @@ def instrument_jit(name: str, fun: Callable, **jit_kwargs) -> Callable:
     return wrapper
 
 
+def instrument_jit_method(name: str, **jit_kwargs) -> Callable:
+    """Decorator twin of :func:`instrument_jit` for methods whose
+    ``self`` is the static argument — the objectives' former
+    ``@partial(jax.jit, static_argnums=0)`` pattern::
+
+        @obs_compile.instrument_jit_method("obj.binary.grads")
+        def _grads(self, score, label, weights): ...
+
+    The returned wrapper is a plain function, so class-attribute access
+    still binds ``self`` (which jax then treats as the static arg);
+    each objective instance compiles once per score signature and its
+    compiles surface as ``jit_trace`` events like every learner site."""
+    def deco(fn):
+        return instrument_jit(name, fn, static_argnums=0, **jit_kwargs)
+    return deco
+
+
 def trace_count(name: str) -> int:
     return registry.count("jit_trace/" + name)
 

@@ -128,6 +128,7 @@ def _reset_warn_once() -> None:
     _warn_once._emitted.clear()
 
 
+from ..obs import compile as obs_compile  # noqa: E402
 from ..obs.registry import add_reset_hook  # noqa: E402
 
 add_reset_hook(_reset_warn_once)
@@ -148,7 +149,10 @@ def _use_pallas() -> bool:
             jnp.zeros((PALLAS_ROW_TILE, 2), dtype=jnp.uint8),
             jnp.ones((PALLAS_ROW_TILE, 4), dtype=jnp.float32),
             16, PALLAS_ROW_TILE)
-        ok = float(probe[0, 0, 3]) == float(PALLAS_ROW_TILE)
+        # jaxlint: disable=JLT001 -- one-shot backend-selection probe
+        # (lru_cached once per process), not a training hot path
+        ok = float(jax.device_get(probe)[0, 0, 3]) == float(
+            PALLAS_ROW_TILE)
         if not ok:
             from ..utils import log
             log.warning("Pallas histogram probe produced wrong sums; "
@@ -278,9 +282,8 @@ except Exception:  # pragma: no cover
 PALLAS_ROW_TILE_INT = 4 * PALLAS_ROW_TILE
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
-def _pallas_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
-                      row_tile: int) -> jnp.ndarray:
+def _pallas_histogram_body(bins: jnp.ndarray, gh: jnp.ndarray,
+                           num_bins: int, row_tile: int) -> jnp.ndarray:
     S, F = bins.shape
     C = gh.shape[1]
     H = -(-num_bins // 16)                       # hi-nibble width
@@ -307,6 +310,11 @@ def _pallas_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
     # [F*H, 16*C] -> [F, H*16, C] -> [F, B, C]
     hist = out.reshape(F, H, 16, C).reshape(F, H * 16, C)
     return hist[:, :num_bins, :]
+
+
+_pallas_histogram = obs_compile.instrument_jit(
+    "ops.pallas_histogram", _pallas_histogram_body,
+    static_argnums=(2, 3))
 
 
 def build_histogram(bins: jnp.ndarray, gh: jnp.ndarray, num_bins: int,

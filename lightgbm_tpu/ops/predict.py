@@ -24,6 +24,7 @@ import numpy as np
 
 from ..io.binning import MissingType
 from ..models.tree import Tree, kCategoricalMask, kDefaultLeftMask
+from ..obs import compile as obs_compile
 from ..utils import next_pow2 as _next_pow2
 
 
@@ -148,8 +149,7 @@ def _build_bundled_device_tree(tree: Tree, bin_meta, B: int,
         leaf_value=jnp.asarray(lv), depth=depth)
 
 
-@partial(jax.jit, static_argnames=("trips",))
-def _traverse(bins, dt: DeviceTree, trips: int) -> jnp.ndarray:
+def _traverse_body(bins, dt: DeviceTree, trips: int) -> jnp.ndarray:
     """Lockstep binned traversal: [n, F] uint bins → [n] i32 leaf ids."""
     n = bins.shape[0]
 
@@ -170,6 +170,10 @@ def _traverse(bins, dt: DeviceTree, trips: int) -> jnp.ndarray:
     # rows still on an internal node after `trips` hops cannot happen when
     # trips >= tree depth; ~node maps leaf encodings back to indices
     return jnp.where(node < 0, ~node, 0).astype(jnp.int32)
+
+
+_traverse = obs_compile.instrument_jit(
+    "predict.traverse", _traverse_body, static_argnames=("trips",))
 
 
 def predict_leaf_on_device(bins_dev: jnp.ndarray,
@@ -311,7 +315,6 @@ def _make_stacked_jits():
     obs/compile.py (one compile per (row-bucket, forest-shape); the
     serve cache pads rows so a second dispatch at the same bucket hits
     the jit cache with zero retraces)."""
-    from ..obs import compile as obs_compile
     leaves = obs_compile.instrument_jit(
         "serve.stacked_leaves", _stacked_leaves_body,
         static_argnames=("trips",))
@@ -324,9 +327,12 @@ def _make_stacked_jits():
 stacked_forest_leaves, stacked_forest_raw = _make_stacked_jits()
 
 
-@jax.jit
-def _gather_leaf_values(leaf_value, leaf):
+def _gather_leaf_values_body(leaf_value, leaf):
     return leaf_value[leaf]
+
+
+_gather_leaf_values = obs_compile.instrument_jit(
+    "predict.gather_leaf", _gather_leaf_values_body)
 
 
 def tree_output_on_device(bins_dev: jnp.ndarray,

@@ -38,10 +38,10 @@ int64 and restores the full range at any scale.
 """
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
+
+from ..obs import compile as obs_compile
 
 # avoid a zero divisor when an iteration's gradients are identically 0
 kTinyScale = 1e-30
@@ -96,8 +96,7 @@ def quant_warn_capped(bits: int, qmax: int, max_rows: int) -> None:
                    component="ops.quantize")
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5))
-def quantize_gh(grad, hess, ind, key, qmax: int, dtype) -> tuple:
+def _quantize_gh(grad, hess, ind, key, qmax: int, dtype) -> tuple:
     """Discretize per-row (grad, hess) to signed integers.
 
     Parameters
@@ -123,6 +122,10 @@ def quantize_gh(grad, hess, ind, key, qmax: int, dtype) -> tuple:
     gh = jnp.stack([qg, qh, ind,
                     jnp.ones_like(ind)], axis=1).astype(dtype)
     return gh, jnp.stack([gs, hs]).astype(jnp.float32)
+
+
+quantize_gh = obs_compile.instrument_jit(
+    "ops.quantize_gh", _quantize_gh, static_argnums=(4, 5))
 
 
 def sum_gh(gh: jnp.ndarray) -> jnp.ndarray:

@@ -209,6 +209,9 @@ class DataParallelTreeLearner(CapabilityMixin):
         across processes for the multi-process subclass too)."""
         sh = (NamedSharding(self.mesh, P(self.axis, None)) if rows > 1
               else self.rep_sharding)
+        # jaxlint: disable=JLT003 -- one-shot sharded-zeros allocation
+        # at CEGB setup (out_shardings is the point); a jit_trace entry
+        # per row-shape would be noise, and no dispatch ever repeats
         return jax.jit(lambda: jnp.zeros((rows, self.Fp),
                                          dtype=jnp.float32),
                        out_shardings=sh)()
@@ -648,7 +651,8 @@ class DataParallelTreeLearner(CapabilityMixin):
         # (extra_trees is ignored under intermediate monotone — serial
         # learner contract, _mono_root in treelearner/serial.py)
         if self._mono_root_fn is None:
-            self._mono_root_fn = jax.jit(
+            self._mono_root_fn = obs_compile.instrument_jit(
+                "mesh.mono_root",
                 lambda b, g, f, r, q: self._root_impl_opts(b, g, f, r,
                                                            False, q))
         return self._mono_root_fn(self.bins, gh, feature_mask,
@@ -771,6 +775,8 @@ class DataParallelTreeLearner(CapabilityMixin):
         with obs.scope("tree::split_batches"):
             state, recs = self._tree_fn(self.bins, state, feature_mask,
                                         rand_seed, self._qscale)
+            # jaxlint: disable=JLT001 -- THE per-tree sync: the whole
+            # tree's split records read back in one hop (scope comment)
             recs_h = jax.device_get(recs)
         with obs.scope("tree::apply_records"):
             for i in range(self.L - 1):

@@ -86,6 +86,9 @@ class FeatureParallelTreeLearner(DataParallelTreeLearner):
 
     def _make_cegb_fetched(self, rows: int) -> jnp.ndarray:
         # rows are replicated in this learner
+        # jaxlint: disable=JLT003 -- one-shot replicated-zeros
+        # allocation at CEGB setup (out_shardings is the point), never
+        # dispatched again
         return jax.jit(lambda: jnp.zeros((rows, self.Fp),
                                          dtype=jnp.float32),
                        out_shardings=self.rep_sharding)()

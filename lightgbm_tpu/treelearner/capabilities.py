@@ -107,6 +107,9 @@ class CapabilityMixin:
         self._qdtype = quant_dtype(qbits)
         quant_warn_capped(qbits, self._qmax, max_rows)
         self._quant_seed = int(getattr(config, "seed", 0)) & 0x7FFFFFFF
+        # base key staged once at setup: a per-tree PRNGKey(seed) would
+        # be an implicit scalar transfer inside the training loop
+        self._quant_base_key = jax.random.PRNGKey(self._quant_seed)
 
     def _quantize_stage(self, grad, hess, ind, tree_no: int):
         """Discretize one tree's (grad, hess, in-bag) to integer rows.
@@ -116,9 +119,9 @@ class CapabilityMixin:
         BIT-IDENTICAL quantized rows — the padding-invariance contract
         make_rand_bins established for extra_trees."""
         from ..ops.quantize import quantize_gh
-        key = jax.random.fold_in(
-            jax.random.PRNGKey(self._quant_seed),
-            jnp.uint32(tree_no & 0x7FFFFFFF))
+        from ..utils.scalars import dev_u32
+        key = jax.random.fold_in(self._quant_base_key,
+                                 dev_u32(tree_no & 0x7FFFFFFF))
         return quantize_gh(grad, hess, ind, key, self._qmax,
                            self._qdtype)
 
@@ -228,6 +231,9 @@ def train_cegb(learner, tree, gh, feature_mask):
         log.warning("CEGB runs without forced splits / per-node "
                     "feature masks")
     state, rec = learner._cegb_root(gh, feature_mask)
+    # jaxlint: disable=JLT001 -- CEGB is a host-stepped driver: the
+    # per-feature penalty depends on host used/fetched state, so one
+    # sync per split is the documented contract of this mode
     pending = jax.device_get(rec)
     for k in range(1, learner.L):
         if not record_is_valid(pending):
@@ -239,6 +245,7 @@ def train_cegb(learner, tree, gh, feature_mask):
                       float(pending.right_total_count))
         state, rec = learner._cegb_step(state, leaf, k, allowed,
                                         feature_mask, smaller)
+        # jaxlint: disable=JLT001 -- per-split sync (CEGB host loop)
         pending = jax.device_get(rec)
     return state
 
@@ -269,6 +276,9 @@ def train_monotone(learner, tree, gh, feature_mask, rand_seed):
                     "monotone_constraints_method=%s"
                     % learner.config.monotone_constraints_method)
     state, rec = learner._mono_root(gh, feature_mask, rand_seed)
+    # jaxlint: disable=JLT001 -- intermediate/advanced monotone growth
+    # is host-stepped (bound propagation walks the host tree); one
+    # sync per split is the mode's documented contract
     pending = jax.device_get(rec)
     gains_h = None
     leaf_sums: dict = {}
@@ -323,6 +333,7 @@ def train_monotone(learner, tree, gh, feature_mask, rand_seed):
                 state, rec, gains_d = learner._adv_scan(
                     state, child, leaf_sums[child], arrs, d,
                     learner._splittable(d), feature_mask)
+        # jaxlint: disable=JLT001 -- per-split sync (monotone host loop)
         pending, gains_h = jax.device_get((rec, gains_d))
         # propagate to contiguous leaves + rescan them
         upd = tracker.leaves_to_update(
@@ -342,6 +353,8 @@ def train_monotone(learner, tree, gh, feature_mask, rand_seed):
                     state, l, leaf_sums[l], (emin, emax),
                     int(tree.leaf_depth[l]), allowed_l, feature_mask)
         if upd:
+            # jaxlint: disable=JLT001 -- re-sync after constrained
+            # rescans of updated leaves (monotone host loop)
             pending, gains_h = jax.device_get((rec, gains_d))
     return state
 
@@ -351,6 +364,9 @@ def train_stepwise(learner, tree, state, rec, feature_mask, rand_seed=0):
     masks depend on the host-side feature path."""
     from .serial import apply_split_record, record_is_valid
 
+    # jaxlint: disable=JLT001 -- per-node feature masks are computed
+    # from the host-side feature path, so this driver syncs per split
+    # by design (its docstring is the contract)
     pending = jax.device_get(rec)
     paths = {0: frozenset()}
     for k in range(1, learner.L):
@@ -368,5 +384,6 @@ def train_stepwise(learner, tree, state, rec, feature_mask, rand_seed=0):
         state, rec = learner._node_step(state, leaf, k, allowed,
                                         mask_left, mask_right, rand_seed,
                                         smaller)
+        # jaxlint: disable=JLT001 -- per-split sync (stepwise host loop)
         pending = jax.device_get(rec)
     return state

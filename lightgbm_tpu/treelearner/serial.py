@@ -60,6 +60,7 @@ from ..ops.split import (FeatureMeta, SplitInfo, SplitParams,
                          calculate_leaf_output, find_best_split,
                          make_rand_bins)
 from ..utils import log, next_pow2 as _next_pow2
+from ..utils.scalars import dev_bool, dev_i32
 from .capabilities import (CapabilityMixin, train_cegb, train_monotone,
                            train_stepwise)
 
@@ -292,6 +293,47 @@ def _go_left_by_bin(col: jnp.ndarray, tbin, default_left,
 # graphs). All data — bins, meta, params — is traced arguments; only
 # shapes and structural flags are static.
 # ----------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _stage_gh_fn_cached(R: int):
+    """One fused dispatch staging (grad, hess, ind) → padded [R, 4] gh.
+    The former eager jnp.ones/stack/concatenate chain launched ~5 tiny
+    dispatches per tree and performed implicit scalar transfers (each
+    fill constant became a device buffer per call) — the transfer-guard
+    sanitizer test pins this staging transfer-free."""
+    def stage(grad, hess, ind):
+        n = grad.shape[0]
+        gh = jnp.stack([grad * ind, hess * ind, ind,
+                        jnp.ones_like(ind)], axis=1)
+        return jnp.concatenate(
+            [gh, jnp.zeros((R - n, 4), dtype=gh.dtype)], axis=0)
+
+    return obs_compile.instrument_jit("serial.stage_gh", stage)
+
+
+@functools.lru_cache(maxsize=None)
+def _rows_out_fn_cached(N: int):
+    """[R] → [N] unpadded row view, jitted: an eager ``[:N]`` slice
+    turns its bounds into device scalars per call (implicit
+    transfers)."""
+    def rows_out(leaf_of_row):
+        return leaf_of_row[:N]
+
+    return obs_compile.instrument_jit("serial.rows_out", rows_out)
+
+
+@functools.lru_cache(maxsize=None)
+def _pad_rows_fn_cached(R: int):
+    """Pad quantized [N, 4] gh rows to the learner's padded row count
+    (zero rows vanish from every histogram sum)."""
+    def pad(gh):
+        n = gh.shape[0]
+        return jnp.concatenate(
+            [gh, jnp.zeros((R - n, gh.shape[1]), dtype=gh.dtype)],
+            axis=0)
+
+    return obs_compile.instrument_jit("serial.pad_gh", pad)
+
 
 def _maybe_rand_bins(extra_trees: bool, rand_seed, node_id, meta, params):
     """Per-node extra_trees random thresholds, or None."""
@@ -847,6 +889,9 @@ class SerialTreeLearner(CapabilityMixin):
         self._leaf_of_row0 = jnp.concatenate([
             jnp.zeros(N, dtype=jnp.int32),
             jnp.full((self.R - N,), -1, dtype=jnp.int32)])
+        # all-rows in-bag indicator, staged once (per-tree creation
+        # would be an implicit scalar transfer per tree)
+        self._ones_ind = jnp.ones(N, dtype=jnp.float32)
         from ..ops.split import pad_feature_meta
         self.meta = pad_feature_meta(
             FeatureMeta.from_dataset(dataset,
@@ -984,10 +1029,15 @@ class SerialTreeLearner(CapabilityMixin):
                                 jnp.int32(tbin), jnp.asarray(allowed),
                                 feature_mask, rand_seed, self._qscale,
                                 self.meta, self.params, self._btab)
+            # jaxlint: disable=JLT001 -- forced splits are a host-
+            # driven preamble (the host must validate each user-forced
+            # split before recording it); runs once per tree root area
             if not bool(jax.device_get(ok)):
                 log.warning("Forced split on feature %d leaves an empty "
                             "side; skipped" % int(spec["feature"]))
                 continue
+            # jaxlint: disable=JLT001 -- forced-split record read-back
+            # (host Tree replay), same preamble as above
             r = jax.device_get(rec)
             apply_split_record(tree, self.dataset, r)
             leaf_total[leaf] = float(r.left_total_count)
@@ -1011,19 +1061,17 @@ class SerialTreeLearner(CapabilityMixin):
         updates (reference: GBDT::UpdateScore uses the learner's partition,
         src/boosting/gbdt.cpp:475)."""
         with obs.scope("tree::stage_gh"):
-            ind = jnp.ones(self.N, dtype=jnp.float32) if bag is None \
-                else bag
+            ind = self._ones_ind if bag is None else bag
             if self._quantized:
                 gh, self._qscale = self._quantize_stage(
                     grad, hess, ind, self._tree_idx + 1)
+                gh = _pad_rows_fn_cached(self.R)(gh)
             else:
-                gh = jnp.stack([grad * ind, hess * ind, ind,
-                                jnp.ones(self.N, dtype=jnp.float32)],
-                               axis=1)
                 self._qscale = self._qs_ones
-            gh = jnp.concatenate(
-                [gh, jnp.zeros((self.R - self.N, 4), dtype=gh.dtype)],
-                axis=0)
+                # one fused dispatch for stack+pad: the former eager
+                # jnp.ones/stack/concatenate chain performed implicit
+                # scalar transfers each tree (transfer-guard sanitizer)
+                gh = _stage_gh_fn_cached(self.R)(grad, hess, ind)
             # fencing mode blocks here so the staging cost lands in THIS
             # stage; sample/trace mode hands the output to the async
             # readiness drainer instead (no hot-path fence)
@@ -1031,20 +1079,22 @@ class SerialTreeLearner(CapabilityMixin):
             feature_mask = self._sample_features()
 
         tree = Tree(self.L)
-        # per-tree extra_trees seed (traced, so no retrace per tree)
+        # per-tree extra_trees seed (traced, so no retrace per tree);
+        # explicit device transfer — see utils/scalars.py
         self._tree_idx += 1
-        rand_seed = jnp.int32(
+        rand_seed = dev_i32(
             (self._extra_seed + 7919 * self._tree_idx) & 0x7FFFFFFF)
         if self._cegb_enabled:
             state = train_cegb(self, tree, gh, feature_mask)
-            return tree, state.leaf_of_row[:self.N]
+            return tree, _rows_out_fn_cached(self.N)(state.leaf_of_row)
         if self._mono_tracker is not None:
             state = train_monotone(self, tree, gh, feature_mask,
                                    rand_seed)
-            return tree, state.leaf_of_row[:self.N]
+            return tree, _rows_out_fn_cached(self.N)(state.leaf_of_row)
         with obs.scope("tree::root_histogram"):
             state, rec = self._root_fn(self.bins, gh, self._leaf_of_row0,
-                                       feature_mask, self._splittable(0),
+                                       feature_mask,
+                                       dev_bool(self._splittable(0)),
                                        rand_seed, self._qscale, self.meta,
                                        self.params, self._btab)
             obs.watch_ready("tree::root_histogram", rec)
@@ -1063,7 +1113,7 @@ class SerialTreeLearner(CapabilityMixin):
         else:
             state = self._train_batched(tree, state, feature_mask,
                                         rand_seed, leaf_total, next_leaf)
-        return tree, state.leaf_of_row[:self.N]
+        return tree, _rows_out_fn_cached(self.N)(state.leaf_of_row)
 
     # ------------------------------------------------------------------
     def _train_batched(self, tree: Tree, state: GrowState,
@@ -1080,10 +1130,13 @@ class SerialTreeLearner(CapabilityMixin):
             # steps fused into one dispatch; the device_get is the
             # per-batch sync, so the scope covers the real device time
             with obs.scope("tree::split_batches"):
-                state, recs = fn(self.bins, state, jnp.int32(next_leaf),
-                                 jnp.int32(max_splits), feature_mask,
+                state, recs = fn(self.bins, state, dev_i32(next_leaf),
+                                 dev_i32(max_splits), feature_mask,
                                  rand_seed, self._qscale, self.meta,
                                  self.params, self._btab)
+                # jaxlint: disable=JLT001 -- THE per-batch host sync:
+                # the split records must reach the host Tree (one
+                # deliberate round-trip per ~log2(L) batch)
                 recs_h = jax.device_get(recs)
             stop = False
             with obs.scope("tree::apply_records"):

@@ -1,0 +1,42 @@
+"""Explicit device scalars for hot-loop dispatch arguments.
+
+``jnp.int32(x)`` / ``jnp.float32(x)`` / ``jnp.ones(...)`` on a Python
+scalar perform an *implicit* host-to-device transfer on every call —
+invisible in traces, flagged by ``jax.transfer_guard("disallow")`` (the
+sanitizer test in tests/test_jaxlint.py), and one tiny blocking
+dispatch each. The helpers here route every per-iteration scalar
+argument through an *explicit* ``jax.device_put`` instead, and cache
+the resulting buffers: leaf indices, batch sizes and boolean gate flags
+repeat across trees, so the steady-state training loop performs ZERO
+host-to-device scalar transfers — the first tree pays one transfer per
+distinct value, later trees hit the cache.
+
+Values that never repeat (per-tree seeds) still go through these
+helpers: the transfer then happens exactly once per tree and is
+explicitly marked as deliberate, which is what keeps the
+transfer-guard sanitizer green.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+
+@functools.lru_cache(maxsize=65536)
+def dev_i32(x: int):
+    """Device int32 scalar via explicit transfer, cached per value."""
+    return jax.device_put(np.int32(x))
+
+
+@functools.lru_cache(maxsize=65536)
+def dev_u32(x: int):
+    """Device uint32 scalar via explicit transfer, cached per value."""
+    return jax.device_put(np.uint32(x))
+
+
+@functools.lru_cache(maxsize=2)
+def dev_bool(x: bool):
+    """Device bool scalar via explicit transfer (two cached values)."""
+    return jax.device_put(np.bool_(x))

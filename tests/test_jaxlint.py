@@ -1,0 +1,594 @@
+"""jaxlint static-analysis suite + transfer-guard runtime sanitizer.
+
+Three layers:
+
+1. per-rule fixture tests — one known-bad snippet per rule asserting
+   the rule fires at the right line with the right id, plus a clean
+   twin asserting no false positive on the sanctioned idiom;
+2. the package-wide clean run (tier-1): ``lightgbm_tpu`` must lint
+   clean, so every future PR inherits the gate;
+3. the runtime complement: a warmed ``GBDT.train_one_iter`` under
+   ``jax.transfer_guard("disallow")`` — the dynamic check that keeps
+   JLT001's static approximation honest (zero implicit host transfers
+   in a full training iteration, exact AND quantized mode).
+"""
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.jaxlint import check_source  # noqa: E402
+from tools.jaxlint.engine import run as jaxlint_run  # noqa: E402
+
+
+def lint(src, relpath="treelearner/somefile.py", select=None):
+    findings, suppressed = check_source(
+        textwrap.dedent(src), relpath, select=select)
+    return findings, suppressed
+
+
+def rules_at(findings):
+    return [(f.rule, f.line) for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# JLT001 — host sync
+# ---------------------------------------------------------------------------
+
+class TestJLT001:
+    def test_item_fires(self):
+        findings, _ = lint("""\
+            import jax.numpy as jnp
+
+            def f(x):
+                s = jnp.sum(x)
+                return s.item()
+            """)
+        assert ("JLT001", 5) in rules_at(findings)
+
+    def test_float_of_tainted_name_fires(self):
+        findings, _ = lint("""\
+            import jax.numpy as jnp
+
+            def f(x):
+                s = jnp.sum(x)
+                return float(s)
+            """)
+        assert ("JLT001", 5) in rules_at(findings)
+
+    def test_device_get_and_block_until_ready_fire(self):
+        findings, _ = lint("""\
+            import jax
+
+            def f(x):
+                jax.device_get(x)
+                x.block_until_ready()
+            """)
+        assert ("JLT001", 4) in rules_at(findings)
+        assert ("JLT001", 5) in rules_at(findings)
+
+    def test_np_asarray_of_jax_call_fires(self):
+        findings, _ = lint("""\
+            import jax.numpy as jnp
+            import numpy as np
+
+            def f(x):
+                return np.asarray(jnp.cumsum(x))
+            """)
+        assert ("JLT001", 5) in rules_at(findings)
+
+    def test_taint_inside_with_block_fires(self):
+        # the shape nearly all hot-path code takes: taint assigned and
+        # synced within one `with obs.scope(...)` block
+        findings, _ = lint("""\
+            import jax.numpy as jnp
+
+            def f(x, obs):
+                with obs.scope("tree::grow"):
+                    s = jnp.sum(x)
+                    return float(s)
+            """)
+        assert ("JLT001", 6) in rules_at(findings)
+
+    def test_taint_inside_loop_body_fires(self):
+        findings, _ = lint("""\
+            import jax.numpy as jnp
+
+            def f(xs):
+                out = []
+                for x in xs:
+                    s = jnp.sum(x)
+                    out.append(float(s))
+                return out
+            """)
+        assert ("JLT001", 7) in rules_at(findings)
+
+    def test_host_values_clean(self):
+        findings, _ = lint("""\
+            import jax
+            import numpy as np
+
+            def f(meta):
+                label = np.asarray(meta.label, dtype=np.float64)
+                devs = np.array(jax.devices())
+                n = int(jax.process_count())
+                return float(label.mean()), devs, n
+            """)
+        assert findings == []
+
+    def test_exempt_modules_clean(self):
+        bad = """\
+            import jax
+
+            def f(x):
+                return jax.device_get(x)
+            """
+        for rel in ("obs/registry.py", "serve/server.py",
+                    "tests/test_x.py"):
+            findings, _ = lint(bad, rel)
+            assert findings == [], rel
+
+
+# ---------------------------------------------------------------------------
+# JLT002 — PRNG key reuse
+# ---------------------------------------------------------------------------
+
+class TestJLT002:
+    def test_double_draw_fires(self):
+        findings, _ = lint("""\
+            import jax
+
+            def f(key):
+                a = jax.random.uniform(key, (3,))
+                b = jax.random.normal(key, (3,))
+                return a + b
+            """)
+        assert ("JLT002", 5) in rules_at(findings)
+
+    def test_split_between_draws_clean(self):
+        findings, _ = lint("""\
+            import jax
+
+            def f(key):
+                k1, k2 = jax.random.split(key)
+                a = jax.random.uniform(k1, (3,))
+                b = jax.random.normal(k2, (3,))
+                return a + b
+            """)
+        assert findings == []
+
+    def test_fold_in_derivation_clean(self):
+        findings, _ = lint("""\
+            import jax
+
+            def f(key, n):
+                out = []
+                for i in range(n):
+                    k = jax.random.fold_in(key, i)
+                    out.append(jax.random.uniform(k, (3,)))
+                return out
+            """)
+        assert findings == []
+
+    def test_reuse_inside_loop_fires(self):
+        findings, _ = lint("""\
+            import jax
+
+            def f(key, n):
+                out = []
+                for i in range(n):
+                    out.append(jax.random.uniform(key, (3,)))
+                return out
+            """)
+        assert any(f.rule == "JLT002" for f in findings)
+
+    def test_helper_call_consumes(self):
+        findings, _ = lint("""\
+            import jax
+
+            def f(self, key):
+                a = self._draw(key)
+                b = jax.random.uniform(key, (3,))
+                return a + b
+            """)
+        assert ("JLT002", 5) in rules_at(findings)
+
+    def test_exclusive_branches_clean(self):
+        findings, _ = lint("""\
+            import jax
+
+            def f(key, flag):
+                if flag:
+                    return jax.random.uniform(key, (3,))
+                else:
+                    return jax.random.normal(key, (3,))
+            """)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# JLT003 — raw jax.jit
+# ---------------------------------------------------------------------------
+
+class TestJLT003:
+    def test_raw_jit_fires(self):
+        findings, _ = lint("""\
+            import jax
+
+            def make(fn):
+                return jax.jit(fn, donate_argnums=(0,))
+            """)
+        assert ("JLT003", 4) in rules_at(findings)
+
+    def test_decorator_and_from_import_fire(self):
+        findings, _ = lint("""\
+            from functools import partial
+            import jax
+            from jax import jit
+
+            @partial(jax.jit, static_argnums=0)
+            def f(self, x):
+                return x
+
+            @jit
+            def g(x):
+                return x
+            """)
+        lines = [l for r, l in rules_at(findings) if r == "JLT003"]
+        assert 5 in lines and 9 in lines
+
+    def test_owner_module_clean(self):
+        findings, _ = lint("""\
+            import jax
+
+            def instrument_jit(name, fun, **kw):
+                return jax.jit(fun, **kw)
+            """, "obs/compile.py")
+        assert findings == []
+
+    def test_instrument_jit_clean(self):
+        findings, _ = lint("""\
+            from ..obs import compile as obs_compile
+
+            def make(fn):
+                return obs_compile.instrument_jit("x", fn)
+            """)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# JLT004 — churn-prone static args
+# ---------------------------------------------------------------------------
+
+class TestJLT004:
+    def test_list_at_static_position_fires(self):
+        findings, _ = lint("""\
+            import jax
+
+            f = jax.jit(lambda a, b: a, static_argnums=(1,))
+            out = f(x, [1, 2, 3])
+            """)
+        assert ("JLT004", 4) in rules_at(findings)
+
+    def test_dict_for_static_name_fires(self):
+        findings, _ = lint("""\
+            from ..obs import compile as obs_compile
+
+            f = obs_compile.instrument_jit(
+                "x", fn, static_argnames=("cfg",))
+            out = f(x, cfg={"a": 1})
+            """)
+        assert any(f.rule == "JLT004" for f in findings)
+
+    def test_tuple_static_clean(self):
+        findings, _ = lint("""\
+            import jax
+
+            f = jax.jit(lambda a, b: a, static_argnums=(1,))
+            out = f(x, (8, False))
+            """, select=["JLT004"])  # raw jax.jit is JLT003's business
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# JLT005 — collectives
+# ---------------------------------------------------------------------------
+
+class TestJLT005:
+    def test_axisless_and_unnamed_fire(self):
+        findings, _ = lint("""\
+            import jax
+
+            def f(h):
+                return jax.lax.psum(h)
+            """)
+        got = [f for f in findings if f.rule == "JLT005"]
+        assert len(got) == 2  # missing axis_name AND missing scope
+        assert all(f.line == 4 for f in got)
+
+    def test_named_scope_with_axis_clean(self):
+        findings, _ = lint("""\
+            import jax
+
+            def f(h, axis):
+                with jax.named_scope("obs_psum_votes"):
+                    return jax.lax.psum(h, axis)
+            """)
+        assert findings == []
+
+    def test_wrong_scope_name_fires(self):
+        findings, _ = lint("""\
+            import jax
+
+            def f(h, axis):
+                with jax.named_scope("my_reduction"):
+                    return jax.lax.psum(h, axis)
+            """)
+        assert [f.rule for f in findings] == ["JLT005"]
+
+
+# ---------------------------------------------------------------------------
+# JLT006 — dtype widening (scoped to the quantized modules)
+# ---------------------------------------------------------------------------
+
+class TestJLT006:
+    def test_float_literal_where_arm_fires(self):
+        findings, _ = lint("""\
+            import jax.numpy as jnp
+
+            def f(mask, x):
+                return jnp.where(mask, x, 0.0)
+            """, "ops/histogram.py")
+        assert ("JLT006", 4) in rules_at(findings)
+
+    def test_dtype_preserving_where_clean(self):
+        findings, _ = lint("""\
+            import jax.numpy as jnp
+
+            def f(mask, x):
+                zero = jnp.zeros((), dtype=x.dtype)
+                return jnp.where(mask, x, zero)
+            """, "ops/quantize.py")
+        assert findings == []
+
+    def test_float_arith_on_int_tainted_fires(self):
+        findings, _ = lint("""\
+            import jax.numpy as jnp
+
+            def f(gh):
+                acc = gh.astype(jnp.int32)
+                return acc * 0.5
+            """, "ops/histogram.py")
+        assert ("JLT006", 5) in rules_at(findings)
+
+    def test_int_taint_inside_if_body_fires(self):
+        findings, _ = lint("""\
+            import jax.numpy as jnp
+
+            def f(gh, quantized):
+                if quantized:
+                    acc = gh.astype(jnp.int32)
+                    return acc * 0.5
+                return gh
+            """, "ops/histogram.py")
+        assert ("JLT006", 6) in rules_at(findings)
+
+    def test_out_of_scope_module_clean(self):
+        findings, _ = lint("""\
+            import jax.numpy as jnp
+
+            def f(mask, x):
+                return jnp.where(mask, x, 0.0)
+            """, "treelearner/serial.py")
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+class TestSuppressions:
+    BAD = """\
+        import jax
+
+        def f(x):
+            return jax.device_get(x)  # jaxlint: disable=JLT001 -- sync pt
+        """
+
+    def test_same_line_suppression_honored(self):
+        findings, suppressed = lint(self.BAD)
+        assert findings == []
+        assert suppressed == 1
+
+    def test_preceding_comment_suppression_honored(self):
+        findings, suppressed = lint("""\
+            import jax
+
+            def f(x):
+                # jaxlint: disable=JLT001 -- deliberate per-batch sync
+                # (two-line rationale keeps working)
+                return jax.device_get(x)
+            """)
+        assert findings == []
+        assert suppressed == 1
+
+    def test_bare_suppression_reports_jlt000(self):
+        findings, suppressed = lint("""\
+            import jax
+
+            def f(x):
+                return jax.device_get(x)  # jaxlint: disable=JLT001
+            """)
+        assert suppressed == 1  # still suppresses JLT001 ...
+        assert [f.rule for f in findings] == ["JLT000"]  # ... loudly
+
+    def test_directive_inside_docstring_inert(self):
+        # suppression syntax QUOTED in documentation must neither
+        # suppress anything nor produce a phantom JLT000
+        findings, suppressed = lint('''\
+            """Docs.
+
+            Example::
+
+                x = jax.device_get(r)  # jaxlint: disable=JLT001
+
+            # jaxlint: disable=JLT002
+            """
+            import jax
+
+            def f(x):
+                return jax.device_get(x)
+            ''')
+        assert suppressed == 0
+        assert [f.rule for f in findings] == ["JLT001"]
+
+    def test_duplicate_findings_deduped(self):
+        # loop bodies are walked twice (JLT002); a reuse inside a loop
+        # must still be reported exactly once per offending call
+        findings, _ = lint("""\
+            import jax
+
+            def f(key, n):
+                for i in range(n):
+                    a = jax.random.uniform(key, (3,))
+                    b = jax.random.normal(key, (3,))
+                return a + b
+            """)
+        keyed = [(f.rule, f.line, f.col) for f in findings]
+        assert len(keyed) == len(set(keyed))
+
+    def test_wrong_rule_id_does_not_suppress(self):
+        findings, suppressed = lint("""\
+            import jax
+
+            def f(x):
+                return jax.device_get(x)  # jaxlint: disable=JLT003 -- no
+            """)
+        assert any(f.rule == "JLT001" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# CLI: JSON output + exit codes (the standalone CI gate)
+# ---------------------------------------------------------------------------
+
+class TestCLI:
+    def test_json_format_and_nonzero_exit(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import jax\n\n\ndef f(x):\n"
+                       "    return jax.device_get(x)\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.jaxlint", str(bad),
+             "--format", "json"],
+            cwd=str(REPO), capture_output=True, text=True)
+        assert proc.returncode == 1
+        report = json.loads(proc.stdout)
+        assert report["counts"] == {"JLT001": 1}
+        assert report["findings"][0]["rule"] == "JLT001"
+        assert report["findings"][0]["line"] == 5
+
+    def test_clean_file_exits_zero(self, tmp_path):
+        ok = tmp_path / "ok.py"
+        ok.write_text("def f(x):\n    return x\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.jaxlint", str(ok)],
+            cwd=str(REPO), capture_output=True, text=True)
+        assert proc.returncode == 0
+
+    def test_single_file_keeps_package_relpath(self):
+        # per-file invocation must classify identically to a package
+        # scan: the jit owner stays exempt, obs/ stays host-sync-exempt
+        for rel in ("obs/compile.py", "obs/registry.py",
+                    "serve/server.py"):
+            proc = subprocess.run(
+                [sys.executable, "-m", "tools.jaxlint",
+                 str(REPO / "lightgbm_tpu" / rel)],
+                cwd=str(REPO), capture_output=True, text=True)
+            assert proc.returncode == 0, (rel, proc.stdout)
+
+    def test_exit_zero_flag(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import jax\n\n\ndef f(x):\n"
+                       "    return jax.device_get(x)\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.jaxlint", str(bad),
+             "--exit-zero"],
+            cwd=str(REPO), capture_output=True, text=True)
+        assert proc.returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# tier-1 gate: the package lints clean
+# ---------------------------------------------------------------------------
+
+class TestPackageClean:
+    def test_package_lints_clean(self):
+        report = jaxlint_run([str(REPO / "lightgbm_tpu")])
+        findings = report.pop("_findings")
+        assert findings == [], "\n".join(f.text() for f in findings)
+        # the suppressions that ARE in the tree all carry rationales
+        # (a bare one would have surfaced as a JLT000 finding above)
+        assert report["suppressed"] > 0
+        assert report["files_scanned"] > 50
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer: transfer_guard("disallow") over a full iteration
+# ---------------------------------------------------------------------------
+
+def _train_warm(params, n_warm=2):
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    rng = np.random.RandomState(7)
+    X = rng.randn(500, 6)
+    if params.get("objective") == "multiclass":
+        y = np.digitize(X[:, 0], [-0.5, 0.5]).astype(np.float64)
+    else:
+        y = (X[:, 0] + 0.5 * X[:, 1] - 0.2 * X[:, 2] > 0) \
+            .astype(np.float64)
+    cfg = Config.from_params(dict(params, num_iterations=10,
+                                  verbosity=-1))
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    booster = create_boosting(cfg, ds)
+    for _ in range(n_warm):
+        booster.train_one_iter()
+    return booster
+
+
+class TestTransferGuardSanitizer:
+    """One full warmed training iteration must perform ZERO implicit
+    host transfers: every scalar/array that crosses to the device does
+    so through an explicit jnp.asarray/device_put (utils/scalars.py),
+    and the only device→host reads are the documented explicit
+    jax.device_get sync points. This is the dynamic check that keeps
+    JLT001's static approximation honest."""
+
+    @pytest.mark.parametrize("params", [
+        {"objective": "binary", "num_leaves": 7},
+        {"objective": "regression", "num_leaves": 7},
+        {"objective": "regression", "num_leaves": 7,
+         "use_quantized_grad": True},
+        {"objective": "multiclass", "num_class": 3, "num_leaves": 7},
+    ], ids=["binary", "regression", "quantized8", "multiclass"])
+    def test_train_iteration_no_implicit_transfers(self, params):
+        import jax
+        booster = _train_warm(params)
+        with jax.transfer_guard("disallow"):
+            booster.train_one_iter()
+        assert booster.iter == 3
+
+    def test_guard_actually_guards(self):
+        # meta-check: the guard in this jax version really does reject
+        # implicit transfers (otherwise the tests above prove nothing)
+        import jax
+        import jax.numpy as jnp
+        with jax.transfer_guard("disallow"):
+            with pytest.raises(Exception, match="[Dd]isallowed"):
+                jnp.ones(4)

@@ -292,21 +292,36 @@ def test_retrace_budget_identical_trains_add_zero_traces():
     must hit every jit cache — zero new traces per instrumented
     function (guards against silent retrace regressions from
     non-weak-typed scalars / changing statics)."""
+    def delta(after, before):
+        # the objectives' static-self jit pattern compiles once per
+        # objective INSTANCE — each train builds a fresh objective, so
+        # one obj.* trace per run is the (pre-PR-5) status quo, merely
+        # made visible by instrument_jit_method; sharing compiles
+        # across config-identical instances is a ROADMAP deferral.
+        # Everything else must hit the cache.
+        return {k: after[k] - before.get(k, 0) for k in after
+                if after[k] != before.get(k, 0)
+                and not k.startswith("obj.")}
+
     _train_small(num_boost_round=2)          # warm all caches
     before = dict(obs_compile.trace_counts())
     _train_small(num_boost_round=2)
     mid = dict(obs_compile.trace_counts())
-    first_run = {k: mid[k] - before.get(k, 0) for k in mid
-                 if mid[k] != before.get(k, 0)}
+    first_run = delta(mid, before)
     _train_small(num_boost_round=2)
     after = dict(obs_compile.trace_counts())
-    second_run = {k: after[k] - mid.get(k, 0) for k in after
-                  if after[k] != mid.get(k, 0)}
+    second_run = delta(after, mid)
     assert first_run == {}, (
         "identical warmed train still traced: %r" % first_run)
     assert second_run == {}, (
         "retrace regression — identical train re-traced: %r"
         % second_run)
+    # the per-instance objective compile stays exactly one per run —
+    # more would be a retrace regression inside one objective instance
+    obj_delta = {k: after[k] - mid.get(k, 0) for k in after
+                 if k.startswith("obj.") and after[k] != mid.get(k, 0)}
+    assert obj_delta, "objective gradient compiles became invisible"
+    assert all(v == 1 for v in obj_delta.values()), obj_delta
 
 
 def test_retrace_warning_resets_with_registry_reset(monkeypatch):

@@ -1,0 +1,35 @@
+"""jaxlint — TPU hot-path static analysis for lightgbm_tpu.
+
+A stdlib-only (ast + tokenize) analyzer whose rules encode this repo's
+hard-won jax invariants — each one a bug class that was originally
+found by hand in review and is now machine-checked:
+
+========  ==============================================================
+JLT001    host-device sync in hot-path modules (``.item()``,
+          ``float()/int()/bool()`` on jax values, ``np.asarray`` of jax
+          values, ``jax.device_get``, ``block_until_ready``)
+JLT002    PRNG key reuse (one key consumed by two ``jax.random`` draws
+          with no interleaving ``split``/``fold_in``)
+JLT003    raw ``jax.jit`` call sites that bypass
+          ``obs/compile.instrument_jit`` (untracked compiles)
+JLT004    unhashable / churn-prone static args (list/dict literals
+          reaching ``static_argnums``/``static_argnames`` positions)
+JLT005    collectives without an ``axis_name`` or outside an
+          ``obs_psum_*`` named scope
+JLT006    dtype-widening hazards in the quantized histogram modules
+          (float literals silently promoting int8/int16 data)
+JLT000    a ``# jaxlint: disable=...`` suppression with no rationale
+==========================================================================
+
+Suppress a finding with a trailing (or immediately preceding) comment
+naming the rule AND the reason::
+
+    x = jax.device_get(rec)  # jaxlint: disable=JLT001 -- per-tree sync
+
+Run: ``python -m tools.jaxlint lightgbm_tpu`` (non-zero exit on
+findings; ``--format json`` for machine consumption). See
+docs/STATIC_ANALYSIS.md for the rule catalog and how to add a rule.
+"""
+from .engine import Finding, check_file, check_source, run  # noqa: F401
+
+__version__ = "1.0"

@@ -1,0 +1,64 @@
+"""CLI: ``python -m tools.jaxlint [paths] [--format text|json] ...``
+
+Exit status: 0 when clean, 1 on findings (use ``--exit-zero`` to
+report without gating), 2 on usage errors — so the tier-1 test suite
+and any CI job can run the analyzer as a standalone gate.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import __version__
+from .engine import run
+from .rules import RULES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.jaxlint",
+        description="TPU hot-path static analysis for lightgbm_tpu")
+    ap.add_argument("paths", nargs="*", default=["lightgbm_tpu"],
+                    help="files or package directories "
+                         "(default: lightgbm_tpu)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--select", metavar="RULES",
+                    help="comma-separated rule ids to run "
+                         "(default: all)")
+    ap.add_argument("--exit-zero", action="store_true",
+                    help="always exit 0 (report-only mode)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--version", action="version",
+                    version="jaxlint " + __version__)
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in sorted(RULES.items()):
+            print("%s  %-14s %s" % (rid, rule.name, rule.summary))
+        print("JLT000  %-14s %s" % ("bare-disable",
+                                    "suppression without a rationale"))
+        return 0
+
+    select = args.select.split(",") if args.select else None
+    report = run(args.paths or ["lightgbm_tpu"], select=select)
+    findings = report.pop("_findings")
+
+    if args.format == "json":
+        json.dump(report, sys.stdout, indent=2)
+        print()
+    else:
+        for f in findings:
+            print(f.text())
+        print("jaxlint: %d finding%s (%d suppressed) in %d file%s"
+              % (len(findings), "s" * (len(findings) != 1),
+                 report["suppressed"], report["files_scanned"],
+                 "s" * (report["files_scanned"] != 1)))
+    if findings and not args.exit_zero:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

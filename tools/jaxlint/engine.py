@@ -1,0 +1,322 @@
+"""jaxlint engine: file discovery, import-alias resolution, suppression
+parsing, and rule driving.
+
+The engine owns everything rule-independent: it parses each file once,
+builds a :class:`FileContext` (AST + canonical-dotted-name resolver +
+module classification), asks every registered rule for findings, and
+filters them through ``# jaxlint: disable=RULE -- reason`` comments.
+Rules live in :mod:`tools.jaxlint.rules` and never read files
+themselves, so adding a rule is one visitor module + one registry line.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: package-relative path prefixes/files where host syncs are the job
+#: (event sinks, the serving front-end) — JLT001 does not apply there.
+HOST_SYNC_EXEMPT = ("obs/", "serve/server.py")
+
+#: modules whose arrays carry the int8/int16 quantized histogram dtype
+#: (JLT006's scope): a stray float literal silently promotes them.
+QUANT_MODULES = ("ops/histogram.py", "ops/quantize.py")
+
+#: the one module allowed to spell ``jax.jit`` (JLT003): every other
+#: site must go through its ``instrument_jit`` so compiles are counted.
+JIT_OWNER = ("obs/compile.py",)
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)"
+    r"(?:\s*--\s*(\S.*?))?\s*$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def text(self) -> str:
+        return "%s:%d:%d: %s %s" % (self.path, self.line, self.col + 1,
+                                    self.rule, self.message)
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FileContext:
+    """Everything a rule needs about one file: the AST, source lines,
+    the scan-root-relative posix path, and import-alias resolution."""
+
+    def __init__(self, source: str, path: str, relpath: str):
+        self.source = source
+        self.path = path
+        self.relpath = relpath.replace("\\", "/")
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._aliases = _import_aliases(self.tree)
+
+    # -- module classification -----------------------------------------
+    @property
+    def is_test(self) -> bool:
+        name = self.relpath.rsplit("/", 1)[-1]
+        return (name.startswith("test_") or "/tests/" in "/" + self.relpath)
+
+    @property
+    def host_sync_exempt(self) -> bool:
+        return self.is_test or _matches(self.relpath, HOST_SYNC_EXEMPT)
+
+    @property
+    def is_quant_module(self) -> bool:
+        return _matches(self.relpath, QUANT_MODULES)
+
+    @property
+    def owns_jit(self) -> bool:
+        return _matches(self.relpath, JIT_OWNER)
+
+    # -- name resolution -----------------------------------------------
+    def canonical(self, node: ast.AST) -> Optional[str]:
+        """Fully-resolved dotted name of a Name/Attribute chain, with
+        import aliases expanded (``jnp.where`` → ``jax.numpy.where``,
+        relative imports keep their module tail: ``obs_compile.x`` from
+        ``from ..obs import compile as obs_compile`` → ``obs.compile.x``).
+        None for anything that is not a plain dotted chain."""
+        parts = _dotted(node)
+        if not parts:
+            return None
+        head = self._aliases.get(parts[0], parts[0])
+        return ".".join([head] + parts[1:]) if len(parts) > 1 else head
+
+
+def _matches(relpath: str, patterns: Sequence[str]) -> bool:
+    rp = relpath
+    for pat in patterns:
+        if pat.endswith("/"):
+            if rp.startswith(pat) or ("/" + pat) in ("/" + rp):
+                return True
+        elif rp == pat or rp.endswith("/" + pat):
+            return True
+    return False
+
+
+def _dotted(node: ast.AST) -> Optional[List[str]]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def _import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """local name → canonical dotted module. Relative imports resolve
+    to their module tail (``from ..obs import compile as obs_compile``
+    → ``obs.compile``): rules match on suffixes like ``instrument_jit``
+    or roots like ``jax``, so the exact package prefix is irrelevant."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                full = (mod + "." + a.name).lstrip(".") if mod else a.name
+                out[a.asname or a.name] = full
+    return out
+
+
+# ----------------------------------------------------------------------
+# suppressions
+# ----------------------------------------------------------------------
+
+class Suppressions:
+    """Per-line ``# jaxlint: disable=RULE[,RULE] [-- reason]`` map.
+
+    A trailing comment suppresses its own line. A standalone comment
+    line suppresses the first following line of code (consecutive
+    comment lines chain, so a two-line rationale above a statement
+    works). Suppressions WITHOUT a rationale still suppress — but the
+    engine reports each one as a JLT000 finding, so an unjustified
+    suppression cannot pass the gate silently.
+
+    Directives are read from real COMMENT tokens (``tokenize``), never
+    from raw line text — suppression syntax quoted inside a docstring
+    (as documentation tends to do) neither suppresses anything nor
+    produces a phantom JLT000.
+    """
+
+    def __init__(self, source):
+        if not isinstance(source, str):
+            source = "\n".join(source) + "\n"
+        comments: Dict[int, Tuple[set, bool, bool]] = {}
+        code_lines: set = set()
+        skip_types = {tokenize.COMMENT, tokenize.NL, tokenize.NEWLINE,
+                      tokenize.INDENT, tokenize.DEDENT,
+                      tokenize.ENCODING, tokenize.ENDMARKER}
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(source).readline))
+        except (tokenize.TokenError, IndentationError):
+            tokens = []
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT:
+                m = _SUPPRESS_RE.search(tok.string)
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",")}
+                    standalone = not tok.line[:tok.start[1]].strip()
+                    comments[tok.start[0]] = (rules, bool(m.group(2)),
+                                              standalone)
+            elif tok.type not in skip_types:
+                for ln in range(tok.start[0], tok.end[0] + 1):
+                    code_lines.add(ln)
+        self.by_line: Dict[int, set] = {}
+        self.bare: List[Tuple[int, str]] = []
+        n_lines = source.count("\n") + 1
+        pending: List[set] = []
+        for i in range(1, n_lines + 1):
+            entry = comments.get(i)
+            if entry is not None:
+                rules, has_reason, standalone = entry
+                if not has_reason:
+                    self.bare.append((i, ",".join(sorted(rules))))
+                if standalone:
+                    pending.append(rules)
+                    continue
+                self.by_line.setdefault(i, set()).update(rules)
+            if i in code_lines:
+                for rules in pending:
+                    self.by_line.setdefault(i, set()).update(rules)
+                pending = []
+            # blank and plain-comment lines keep pending alive
+
+    def active(self, rule: str, line: int) -> bool:
+        return rule in self.by_line.get(line, ())
+
+
+# ----------------------------------------------------------------------
+# driving
+# ----------------------------------------------------------------------
+
+def _rules(select: Optional[Iterable[str]] = None):
+    from .rules import RULES
+    if select is None:
+        return list(RULES.values())
+    wanted = {s.strip().upper() for s in select}
+    wanted.discard("JLT000")  # engine-level rule, always available
+    unknown = wanted - set(RULES)
+    if unknown:
+        raise SystemExit("unknown rule id(s): %s (known: %s)"
+                         % (", ".join(sorted(unknown)),
+                            ", ".join(sorted(RULES))))
+    return [r for rid, r in RULES.items() if rid in wanted]
+
+
+def check_source(source: str, relpath: str = "<string>",
+                 select: Optional[Iterable[str]] = None,
+                 path: Optional[str] = None
+                 ) -> Tuple[List[Finding], int]:
+    """Lint one source string; returns (findings, n_suppressed).
+    ``relpath`` drives module classification (pass e.g.
+    ``"treelearner/serial.py"`` to simulate a package location)."""
+    ctx = FileContext(source, path or relpath, relpath)
+    sup = Suppressions(ctx.source)
+    raw: List[Finding] = []
+    for rule in _rules(select):
+        raw.extend(rule.check(ctx))
+    # identical findings dedupe (e.g. JLT002 walks loop bodies twice —
+    # a reuse in a loop must not be reported twice)
+    raw = list(dict.fromkeys(raw))
+    findings = [f for f in raw if not sup.active(f.rule, f.line)]
+    suppressed = len(raw) - len(findings)
+    if select is None or "JLT000" in {s.upper() for s in select}:
+        for line, rules in sup.bare:
+            findings.append(Finding(
+                "JLT000", ctx.path, line, 0,
+                "suppression of %s has no rationale — write "
+                "'# jaxlint: disable=%s -- <why this is sound>'"
+                % (rules, rules)))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, suppressed
+
+
+def check_file(path: str, root: Optional[str] = None,
+               select: Optional[Iterable[str]] = None
+               ) -> Tuple[List[Finding], int]:
+    p = Path(path)
+    rel = str(p.resolve().relative_to(Path(root).resolve())) if root \
+        else p.name
+    return check_source(p.read_text(encoding="utf-8"), rel,
+                        select=select, path=str(p))
+
+
+def _package_root(file_path: Path) -> Path:
+    """Topmost ancestor directory that is itself a package (has an
+    ``__init__.py``): linting ``lightgbm_tpu/obs/compile.py`` alone
+    must classify it as ``obs/compile.py`` — the same relpath a
+    package-directory scan produces — or per-file invocations would
+    lose every path-scoped exemption."""
+    root = file_path.parent
+    while (root / "__init__.py").exists() and root.parent != root:
+        root = root.parent
+    if root == file_path.parent:
+        return root
+    # root is now one above the outermost package dir; anchor there so
+    # relpaths start INSIDE the package ("obs/compile.py", not
+    # "lightgbm_tpu/obs/compile.py" — patterns are package-relative)
+    outer = file_path.parent
+    while outer.parent != root:
+        outer = outer.parent
+    return outer
+
+
+def iter_py_files(paths: Sequence[str]):
+    """Yield (file, root) pairs; ``root`` anchors the relative path the
+    module-classification patterns match against."""
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if "__pycache__" in f.parts:
+                    continue
+                yield str(f), str(p)
+        elif p.suffix == ".py":
+            yield str(p), str(_package_root(p.resolve()))
+        else:
+            raise SystemExit("not a python file or directory: %s" % raw)
+
+
+def run(paths: Sequence[str],
+        select: Optional[Iterable[str]] = None) -> dict:
+    """Lint ``paths`` (files or directory trees); returns the report
+    dict the CLI renders (text or JSON)."""
+    findings: List[Finding] = []
+    suppressed = 0
+    n_files = 0
+    for f, root in iter_py_files(paths):
+        n_files += 1
+        got, sup = check_file(f, root=root, select=select)
+        findings.extend(got)
+        suppressed += sup
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return {
+        "files_scanned": n_files,
+        "findings": [f.as_dict() for f in findings],
+        "counts": dict(sorted(counts.items())),
+        "suppressed": suppressed,
+        "_findings": findings,
+    }

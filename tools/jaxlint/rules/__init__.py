@@ -1,0 +1,131 @@
+"""Rule registry. Each rule module exposes one ``Rule`` subclass;
+register it here and it participates in every run, ``--select``, and
+``--list-rules``."""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+from ..engine import FileContext, Finding
+
+
+class Rule:
+    """Base: subclasses set ``id``/``name``/``summary`` and implement
+    :meth:`check` yielding findings for one parsed file."""
+
+    id: str = ""
+    name: str = ""
+    summary: str = ""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: FileContext, node: ast.AST,
+                message: str) -> Finding:
+        return Finding(self.id, ctx.path, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), message)
+
+
+def scope_statements(scope: ast.AST) -> List[ast.stmt]:
+    """Direct statements of a scope (module or function body), for
+    rules that need statement ORDER. Nested function/class bodies are
+    their own scopes and are excluded."""
+    body = getattr(scope, "body", [])
+    return list(body)
+
+
+def walk_scopes(tree: ast.Module):
+    """Yield every scope node: the module, each class body (for
+    class-level assignments) and each (async) function — lambdas ride
+    along in their enclosing scope."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            yield node
+
+
+def _stmt_blocks(stmt: ast.stmt):
+    """Nested statement blocks of a compound statement (with/for/if/
+    try bodies), in source order."""
+    for field in ("body", "orelse", "finalbody"):
+        blk = getattr(stmt, field, None)
+        if isinstance(blk, list) and blk \
+                and isinstance(blk[0], ast.stmt):
+            yield blk
+    for handler in getattr(stmt, "handlers", []) or []:
+        yield handler.body
+
+
+def iter_statements_ordered(body):
+    """Every statement of a scope in source order, RECURSING into
+    compound-statement bodies (with/for/if/try) but not into nested
+    function/class definitions. Pair each yielded statement with
+    :func:`shallow_walk` to visit its own expressions exactly once —
+    taint-tracking rules need assignments inside a ``with`` or loop
+    body to take effect before later statements of the same block."""
+    for s in body:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        yield s
+        for blk in _stmt_blocks(s):
+            yield from iter_statements_ordered(blk)
+
+
+def shallow_walk(stmt: ast.stmt):
+    """Walk one statement's own expressions: nested statements (a
+    compound statement's body) and nested defs are NOT descended into —
+    they are yielded separately by :func:`iter_statements_ordered`."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt) \
+                    or isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def walk_in_scope(stmt: ast.stmt):
+    """ast.walk over one statement, NOT descending into nested
+    function/class definitions (those are separate scopes, visited via
+    :func:`walk_scopes`). A def/class at the root yields nothing for
+    the same reason."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def const_float(node: ast.AST) -> bool:
+    """A float literal, including a negated one (``-1.0``)."""
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        node = node.operand
+    return isinstance(node, ast.Constant) and isinstance(node.value, float)
+
+
+from .jlt001_host_sync import HostSyncRule          # noqa: E402
+from .jlt002_key_reuse import KeyReuseRule          # noqa: E402
+from .jlt003_raw_jit import RawJitRule              # noqa: E402
+from .jlt004_static_args import StaticArgsRule      # noqa: E402
+from .jlt005_collectives import CollectivesRule     # noqa: E402
+from .jlt006_dtype_widening import DtypeWideningRule  # noqa: E402
+
+RULES = {r.id: r for r in (
+    HostSyncRule(), KeyReuseRule(), RawJitRule(), StaticArgsRule(),
+    CollectivesRule(), DtypeWideningRule())}

@@ -1,0 +1,157 @@
+"""JLT001 — host-device synchronization in hot-path modules.
+
+The bug class: training/serving hot loops that silently pull device
+values to the host (or push host scalars to the device) — ``.item()``,
+``float()/int()/bool()`` on a jax value, ``np.asarray`` of a jax value,
+``jax.device_get``, ``.block_until_ready()``. Each one is a blocking
+round-trip the device trace never shows; on a remote TPU a single stray
+``.item()`` per split step serializes the whole pipeline (the exact
+failure mode the GPU GBDT literature guards its kernels against).
+
+Scope: every module except ``obs/`` (whose JOB is reading device state
+off the hot path), ``serve/server.py`` (the host-facing front end) and
+tests. Deliberate syncs — the per-batch split-record read-back, the
+one-shot Pallas probe — carry ``# jaxlint: disable=JLT001 -- reason``
+suppressions at the call site, which is exactly the point: every sync
+in a hot-path module is either machine-checked out or visibly argued
+for in-line.
+
+Jax-ness of a conversion argument is decided by local taint: the
+argument is itself a ``jax.*``/``jnp.*`` call, or a name assigned from
+one earlier in the same scope (single-assignment tracking; attribute
+reads like ``self.label`` are NOT tainted — one-time setup conversions
+of stored arrays are normal). Cross-function flow is out of scope
+(ROADMAP: deferred).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ..engine import FileContext, Finding
+from . import Rule, iter_statements_ordered, shallow_walk, walk_scopes
+
+_CONVERTERS = {"float", "int", "bool"}
+_NP_CONVERTERS = {"numpy.asarray", "numpy.array"}
+#: jax-rooted calls whose RESULT is a host value (device handles,
+#: process topology, completed cross-process gathers) — converting
+#: those is not a device sync, so they are not taint sources
+_HOST_RESULTS = (
+    "jax.device_get", "jax.devices", "jax.local_devices",
+    "jax.device_count", "jax.local_device_count", "jax.process_count",
+    "jax.process_index", "jax.default_backend",
+    "jax.experimental.multihost_utils.process_allgather",
+)
+
+
+def _is_jax_call(ctx: FileContext, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    canon = ctx.canonical(node.func)
+    if not canon or not (canon == "jax" or canon.startswith("jax.")):
+        return False
+    host_tails = tuple("." + h.rsplit(".", 1)[-1] for h in _HOST_RESULTS)
+    return not (canon in _HOST_RESULTS or canon.endswith(host_tails))
+
+
+class HostSyncRule(Rule):
+    id = "JLT001"
+    name = "host-sync"
+    summary = ("implicit host-device synchronization in a hot-path "
+               "module")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.host_sync_exempt:
+            return
+        for scope in walk_scopes(ctx.tree):
+            yield from self._check_scope(ctx, scope)
+
+    def _check_scope(self, ctx, scope) -> Iterator[Finding]:
+        tainted: Set[str] = set()
+        # statement-granular ordering: assignments inside a with/loop/
+        # if body must taint BEFORE later statements of the same block
+        # are checked, while within ONE statement the checks run first
+        # (in ``x = jnp.f(np.g(x))`` the RHS is judged against x's
+        # previous binding)
+        for stmt in iter_statements_ordered(scope.body):
+            nodes = self._ordered(stmt)
+            for node in nodes:
+                yield from self._check_node(ctx, node, tainted)
+            for node in nodes:
+                self._update_taint(ctx, node, tainted)
+
+    @staticmethod
+    def _ordered(stmt):
+        nodes = list(shallow_walk(stmt))
+        nodes.sort(key=lambda n: (getattr(n, "lineno", 0),
+                                  getattr(n, "col_offset", 0)))
+        return nodes
+
+    def _update_taint(self, ctx, node, tainted: Set[str]) -> None:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            return
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            return
+        value = node.value
+        src_tainted = _is_jax_call(ctx, value)
+        if isinstance(value, (ast.BinOp, ast.Subscript)):
+            inner = (value.left if isinstance(value, ast.BinOp)
+                     else value.value)
+            if isinstance(inner, ast.Name) and inner.id in tainted:
+                src_tainted = True
+        if src_tainted:
+            tainted.add(tgt.id)
+        else:
+            tainted.discard(tgt.id)
+
+    def _check_node(self, ctx, node, tainted) -> Iterator[Finding]:
+        if not isinstance(node, ast.Call):
+            return
+        func = node.func
+        # unconditional syncs
+        if isinstance(func, ast.Attribute):
+            if func.attr == "item" and not node.args:
+                yield self.finding(
+                    ctx, node,
+                    ".item() forces a device→host sync; read values "
+                    "via jax.device_get at a deliberate sync point "
+                    "(and suppress with a rationale)")
+                return
+            if func.attr == "block_until_ready":
+                yield self.finding(
+                    ctx, node,
+                    ".block_until_ready() fences the dispatch "
+                    "pipeline; only obs/ may fence (readiness "
+                    "drainer) — move the wait or suppress with a "
+                    "rationale")
+                return
+        canon = ctx.canonical(func)
+        if canon == "jax.device_get":
+            yield self.finding(
+                ctx, node,
+                "jax.device_get blocks on the device; a hot-path "
+                "module may only sync at its documented per-batch "
+                "read-back — suppress with a rationale if this IS "
+                "that point")
+            return
+        # conversions of jax values
+        name = (canon or "").split(".")[-1] if canon else ""
+        is_converter = (isinstance(func, ast.Name)
+                        and func.id in _CONVERTERS) \
+            or (canon in _NP_CONVERTERS)
+        if not is_converter or not node.args:
+            return
+        arg = node.args[0]
+        arg_is_jax = _is_jax_call(ctx, arg) \
+            or (isinstance(arg, ast.Name) and arg.id in tainted) \
+            or (isinstance(arg, ast.Subscript)
+                and isinstance(arg.value, ast.Name)
+                and arg.value.id in tainted)
+        if arg_is_jax:
+            label = func.id if isinstance(func, ast.Name) else name
+            yield self.finding(
+                ctx, node,
+                "%s() on a jax value synchronizes with the device; "
+                "keep the computation on device or device_get at a "
+                "deliberate sync point" % label)

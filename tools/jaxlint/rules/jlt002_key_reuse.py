@@ -1,0 +1,174 @@
+"""JLT002 — PRNG key reuse.
+
+The bug class behind the pre-PR-3 ``make_rand_bins`` padding
+divergence: the same PRNG key flowing into two ``jax.random.*`` draws
+(directly, or via a helper call) without an interleaving ``split`` /
+``fold_in``. Jax keys are VALUES, not stateful generators — a reused
+key re-produces the same stream, which in this codebase showed up as
+serial/mesh learners drawing "random" thresholds that silently agreed
+or diverged depending on padding.
+
+Tracking is scope-local and branch-aware but deliberately simple
+(cross-function key flow is a ROADMAP deferral):
+
+- a name holds a key if it is a parameter named ``key``/``rng``/
+  ``*_key``/``keys`` or is assigned from ``jax.random.PRNGKey`` /
+  ``split`` / ``fold_in`` (tuple unpacking from ``split`` included);
+  dotted stores like ``self._key`` participate too;
+- deriving calls (``split``/``fold_in``/``PRNGKey``/``key_data``/
+  ``clone``) do NOT consume; any other call a key is passed to DOES
+  (a sampler, or a helper that presumably samples);
+- reassignment from a deriver starts a fresh generation; consuming the
+  same generation twice is the finding;
+- ``if``/``else`` branches are analyzed independently and merged
+  (exclusive branches may each consume once); loop bodies are walked
+  twice so a consume-without-reassign inside a loop is caught.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..engine import FileContext, Finding
+from . import Rule
+
+_KEY_PARAM = re.compile(r"(^|_)(key|rng|keys)$")
+_DERIVERS = {"PRNGKey", "key", "split", "fold_in", "key_data",
+             "wrap_key_data", "clone"}
+
+
+def _key_expr_name(node: ast.AST) -> Optional[str]:
+    """Dotted string for Name/Attribute chains (``key``, ``self._key``);
+    None otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(parts[::-1])
+    return None
+
+
+class _State:
+    __slots__ = ("gen", "used")
+
+    def __init__(self):
+        self.gen: Dict[str, int] = {}       # name -> generation
+        self.used: Dict[str, Tuple[int, int]] = {}  # name -> (gen, line)
+
+    def clone(self) -> "_State":
+        s = _State()
+        s.gen = dict(self.gen)
+        s.used = dict(self.used)
+        return s
+
+    def merge(self, a: "_State", b: "_State") -> None:
+        names = set(a.gen) | set(b.gen)
+        self.gen = {}
+        self.used = {}
+        for n in names:
+            ga, gb = a.gen.get(n, -1), b.gen.get(n, -1)
+            self.gen[n] = max(ga, gb)
+            ua, ub = a.used.get(n), b.used.get(n)
+            # keep a consume only if it happened at the surviving
+            # generation; exclusive-branch consumes merge to one
+            for u in (ua, ub):
+                if u is not None and u[0] == self.gen[n]:
+                    self.used[n] = u
+
+
+class KeyReuseRule(Rule):
+    id = "JLT002"
+    name = "key-reuse"
+    summary = ("PRNG key consumed twice without an interleaving "
+               "split/fold_in")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                state = _State()
+                for arg in (list(node.args.posonlyargs)
+                            + list(node.args.args)
+                            + list(node.args.kwonlyargs)):
+                    if _KEY_PARAM.search(arg.arg):
+                        state.gen[arg.arg] = 0
+                self._walk_block(ctx, node.body, state, out)
+        return iter(out)
+
+    # -- statement walking ---------------------------------------------
+    def _walk_block(self, ctx, stmts, state: _State, out) -> None:
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            if isinstance(s, ast.If):
+                a, b = state.clone(), state.clone()
+                self._walk_block(ctx, s.body, a, out)
+                self._walk_block(ctx, s.orelse, b, out)
+                state.merge(a, b)
+            elif isinstance(s, (ast.For, ast.AsyncFor, ast.While)):
+                self._walk_block(ctx, s.body, state, out)
+                self._walk_block(ctx, s.body, state, out)
+                self._walk_block(ctx, s.orelse, state, out)
+            elif isinstance(s, ast.With):
+                self._walk_block(ctx, s.body, state, out)
+            elif isinstance(s, ast.Try):
+                self._walk_block(ctx, s.body, state, out)
+                for h in s.handlers:
+                    self._walk_block(ctx, h.body, state.clone(), out)
+                self._walk_block(ctx, s.finalbody, state, out)
+            else:
+                self._process_stmt(ctx, s, state, out)
+
+    # -- one simple statement ------------------------------------------
+    def _process_stmt(self, ctx, stmt, state: _State, out) -> None:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                self._process_call(ctx, node, state, out)
+        if isinstance(stmt, ast.Assign):
+            self._process_assign(ctx, stmt, state)
+
+    def _process_call(self, ctx, call, state: _State, out) -> None:
+        canon = ctx.canonical(call.func) or ""
+        if canon.startswith("jax.random.") \
+                and canon.rsplit(".", 1)[-1] in _DERIVERS:
+            return  # deriving a key never consumes it
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            name = _key_expr_name(arg)
+            if name is None or name not in state.gen:
+                continue
+            gen = state.gen[name]
+            prev = state.used.get(name)
+            if prev is not None and prev[0] == gen:
+                out.append(self.finding(
+                    ctx, call,
+                    "PRNG key %r already consumed at line %d with no "
+                    "interleaving jax.random.split/fold_in — reusing "
+                    "it replays the same random stream" %
+                    (name, prev[1])))
+            else:
+                state.used[name] = (gen, call.lineno)
+
+    def _process_assign(self, ctx, stmt, state: _State) -> None:
+        value = stmt.value
+        canon = ctx.canonical(value.func) or "" \
+            if isinstance(value, ast.Call) else ""
+        derives = (canon.startswith("jax.random.")
+                   and canon.rsplit(".", 1)[-1] in _DERIVERS)
+        for tgt in stmt.targets:
+            elts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) \
+                else [tgt]
+            for el in elts:
+                name = _key_expr_name(el)
+                if name is None:
+                    continue
+                if derives or _KEY_PARAM.search(name.rsplit(".", 1)[-1]):
+                    state.gen[name] = state.gen.get(name, -1) + 1
+                    state.used.pop(name, None)
+                elif name in state.gen:
+                    # overwritten with a non-key value
+                    del state.gen[name]
+                    state.used.pop(name, None)

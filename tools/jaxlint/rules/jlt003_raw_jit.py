@@ -1,0 +1,50 @@
+"""JLT003 — raw ``jax.jit`` call sites.
+
+``obs/compile.instrument_jit`` is the sanctioned owner of every jit
+boundary: it counts traces, warns on retrace storms, captures
+cost_analysis FLOPs/bytes into ``jit_trace`` events, and feeds the
+roofline summary. A raw ``jax.jit`` site is a compile boundary the
+observability layer cannot see — it was exactly how the objectives'
+gradient compiles stayed invisible until PR 5 migrated them. This rule
+is the enforcement arm of ``instrument_jit`` (docs/OBSERVABILITY.md).
+
+Flags any reference to ``jax.jit`` (attribute access, ``from jax
+import jit``, ``functools.partial(jax.jit, ...)`` — all reduce to the
+same resolved name) outside ``obs/compile.py``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import FileContext, Finding
+from . import Rule
+
+
+class RawJitRule(Rule):
+    id = "JLT003"
+    name = "raw-jit"
+    summary = "jax.jit call site bypassing obs/compile.instrument_jit"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.owns_jit or ctx.is_test:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Attribute, ast.Name)):
+                continue
+            # only flag the outermost Attribute of a chain
+            if isinstance(node, ast.Name) and ctx.canonical(node) \
+                    == "jax.jit":
+                yield self._hit(ctx, node)
+            elif isinstance(node, ast.Attribute) \
+                    and ctx.canonical(node) == "jax.jit":
+                yield self._hit(ctx, node)
+
+    def _hit(self, ctx, node) -> Finding:
+        return self.finding(
+            ctx, node,
+            "raw jax.jit bypasses compile tracking — use "
+            "obs/compile.instrument_jit(name, fn, **jit_kwargs) (or "
+            "instrument_jit_method for static-self methods) so the "
+            "compile shows up in jit_trace events and the roofline "
+            "summary")

@@ -1,0 +1,148 @@
+"""JLT004 — unhashable or churn-prone static arguments.
+
+``static_argnums``/``static_argnames`` make jax HASH the argument and
+key the compile cache on it. A list/dict/set (or a comprehension)
+reaching a static position either crashes (unhashable) or — wrapped in
+a tuple by a well-meaning caller — becomes a retrace bomb: every
+distinct value compiles a fresh executable. The learners thread their
+static config through frozen tuples (``hist_impl``) for exactly this
+reason.
+
+Detection is binding-local: the rule records names bound (or
+immediately called) from ``jax.jit(...)`` / ``instrument_jit(...)``
+with literal ``static_argnums``/``static_argnames``, then flags calls
+through those names that place a list/dict/set literal or comprehension
+at a static position. Cross-module call tracking is a deferred ROADMAP
+item — the gate this rule provides is "the obvious local mistake never
+lands".
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..engine import FileContext, Finding
+from . import Rule
+
+_MUTABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+            ast.SetComp, ast.GeneratorExp)
+
+
+def _is_jit_maker(ctx: FileContext, func: ast.AST) -> bool:
+    canon = ctx.canonical(func) or ""
+    return canon == "jax.jit" or canon.rsplit(".", 1)[-1] in (
+        "instrument_jit", "instrument_jit_method")
+
+
+def _literal_ints(node: ast.AST) -> Optional[Set[int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, int)):
+                return None
+            out.add(el.value)
+        return out
+    return None
+
+
+def _literal_strs(node: ast.AST) -> Optional[Set[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                return None
+            out.add(el.value)
+        return out
+    return None
+
+
+def _static_spec(ctx, call: ast.Call
+                 ) -> Optional[Tuple[Set[int], Set[str], int]]:
+    """(static positions, static names, positional offset) of a
+    jit-maker call, or None. instrument_jit's leading ``name`` argument
+    does not shift anything: the wrapped function's own signature is
+    what argnums index."""
+    if not _is_jit_maker(ctx, call.func):
+        return None
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            got = _literal_ints(kw.value)
+            if got:
+                nums |= got
+        elif kw.arg == "static_argnames":
+            got = _literal_strs(kw.value)
+            if got:
+                names |= got
+    if not nums and not names:
+        return None
+    return nums, names, 0
+
+
+class StaticArgsRule(Rule):
+    id = "JLT004"
+    name = "static-args"
+    summary = ("list/dict literal reaching a static_argnums/"
+               "static_argnames position (retrace bomb)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        bindings: Dict[str, Tuple[Set[int], Set[str]]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.value, ast.Call):
+                spec = _static_spec(ctx, node.value)
+                if spec:
+                    tgt = node.targets[0]
+                    name = None
+                    if isinstance(tgt, ast.Name):
+                        name = tgt.id
+                    elif isinstance(tgt, ast.Attribute) \
+                            and isinstance(tgt.value, ast.Name):
+                        name = tgt.value.id + "." + tgt.attr
+                    if name:
+                        bindings[name] = (spec[0], spec[1])
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Call):
+                # immediate call: jax.jit(f, static_argnums=...)(args)
+                spec = _static_spec(ctx, node.func)
+                if spec:
+                    yield from self._check_call(ctx, node, spec[0],
+                                                spec[1])
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = None
+            if isinstance(node.func, ast.Name):
+                name = node.func.id
+            elif isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name):
+                name = node.func.value.id + "." + node.func.attr
+            if name in bindings:
+                nums, names = bindings[name]
+                yield from self._check_call(ctx, node, nums, names)
+
+    def _check_call(self, ctx, call: ast.Call, nums: Set[int],
+                    names: Set[str]) -> Iterator[Finding]:
+        for i, arg in enumerate(call.args):
+            if i in nums and isinstance(arg, _MUTABLE):
+                yield self.finding(
+                    ctx, arg,
+                    "mutable %s literal at static position %d: "
+                    "unhashable (TypeError) — pass a frozen tuple, and "
+                    "only if its values are few and stable (every "
+                    "distinct static value compiles a new executable)"
+                    % (type(arg).__name__.lower(), i))
+        for kw in call.keywords:
+            if kw.arg in names and isinstance(kw.value, _MUTABLE):
+                yield self.finding(
+                    ctx, kw.value,
+                    "mutable %s literal for static arg %r: unhashable "
+                    "(TypeError) — pass a frozen tuple of few, stable "
+                    "values" % (type(kw.value).__name__.lower(), kw.arg))

@@ -1,0 +1,94 @@
+"""JLT005 — collectives must be named and attributable.
+
+Two invariants from the mesh learners:
+
+1. every collective (``psum``/``all_gather``/``ppermute``/...) names
+   its mesh axis — an axis-less collective either fails late inside
+   ``shard_map``/``pmap`` or silently reduces over the wrong axis when
+   meshes gain a second dimension;
+2. every collective sits inside a ``jax.named_scope("obs_psum_*")``
+   block, so the XLA-inserted cross-device reduce is attributable in
+   device traces (PR 1's convention; tools/trace_report.py groups
+   device time by these names). A bare psum is untraceable bytes on
+   the interconnect.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import FileContext, Finding
+from . import Rule
+
+_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                "ppermute", "all_to_all", "psum_scatter", "pshuffle"}
+_SCOPE_PREFIX = "obs_psum_"
+
+
+def _scope_name(with_node: ast.With):
+    for item in with_node.items:
+        call = item.context_expr
+        if isinstance(call, ast.Call) and call.args:
+            arg = call.args[0]
+            func = call.func
+            is_named_scope = (isinstance(func, ast.Attribute)
+                              and func.attr == "named_scope") or \
+                             (isinstance(func, ast.Name)
+                              and func.id == "named_scope")
+            if is_named_scope and isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str):
+                return arg.value
+            if is_named_scope:
+                return ""  # dynamic name: treat as unknown-but-named
+    return None
+
+
+class CollectivesRule(Rule):
+    id = "JLT005"
+    name = "collectives"
+    summary = ("collective without axis_name or outside an obs_psum_* "
+               "named scope")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        yield from self._visit(ctx, ctx.tree, in_scope=False)
+
+    def _visit(self, ctx, node, in_scope: bool) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            child_in_scope = in_scope
+            if isinstance(child, ast.With):
+                name = _scope_name(child)
+                if name is not None:
+                    # a dynamic (non-literal) named_scope counts as
+                    # named: the data-parallel learner picks between
+                    # obs_psum_* strings at trace time
+                    child_in_scope = in_scope or name == "" \
+                        or name.startswith(_SCOPE_PREFIX)
+            if isinstance(child, ast.Call):
+                yield from self._check_call(ctx, child, child_in_scope)
+            yield from self._visit(ctx, child, child_in_scope)
+
+    def _check_call(self, ctx, call: ast.Call,
+                    in_scope: bool) -> Iterator[Finding]:
+        canon = ctx.canonical(call.func) or ""
+        tail = canon.rsplit(".", 1)[-1]
+        if tail not in _COLLECTIVES:
+            return
+        if not (canon.startswith("jax.lax.") or canon.startswith("lax.")
+                or canon.startswith("jax.")):
+            return
+        has_axis = len(call.args) >= 2 or any(
+            kw.arg == "axis_name" for kw in call.keywords)
+        if not has_axis:
+            yield self.finding(
+                ctx, call,
+                "%s without an axis_name: name the mesh axis "
+                "explicitly — axis-less collectives break (or reduce "
+                "over the wrong axis) the moment the mesh gains a "
+                "second dimension" % tail)
+        if not in_scope:
+            yield self.finding(
+                ctx, call,
+                "%s outside a jax.named_scope(\"obs_psum_*\") block: "
+                "wrap it so the cross-device reduce is attributable "
+                "in device traces (tools/trace_report.py groups on "
+                "these names)" % tail)

@@ -1,0 +1,116 @@
+"""JLT006 — dtype-widening hazards in the quantized histogram modules.
+
+PR 4 made integer histogram dtypes load-bearing: int8/int16 gh rows
+accumulate into int32/int64 histograms whose sums are EXACT (bit-exact
+sibling subtraction, exact zero-bin residuals). A stray Python float
+literal in that data path silently promotes everything back to f32 —
+correctness quietly degrades to the old accumulation-order drift and
+the bandwidth win evaporates (4x the bytes). The fix idiom is a
+dtype-preserving neutral element: ``zero = jnp.zeros((), dtype=g.dtype)``
+then ``jnp.where(mask, x, zero)``.
+
+Two checks, scoped to the quantized modules (engine.QUANT_MODULES):
+
+- a ``jnp.where`` whose arms mix a float literal with a non-float
+  value (the literal promotes the other arm);
+- arithmetic between a float literal and a name that locally carries
+  an integer dtype (assigned via ``.astype(jnp.int8/16/32/64)``, a
+  ``dtype=jnp.intNN`` keyword, or ``sum_gh``).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ..engine import FileContext, Finding
+from . import Rule, const_float, iter_statements_ordered, \
+    shallow_walk, walk_scopes
+
+_INT_DTYPES = {"int8", "int16", "int32", "int64", "uint8", "uint16",
+               "uint32", "uint64"}
+
+
+def _int_dtype_expr(ctx, node: ast.AST) -> bool:
+    canon = ctx.canonical(node) or ""
+    return canon.rsplit(".", 1)[-1] in _INT_DTYPES or (
+        isinstance(node, ast.Constant) and node.value in _INT_DTYPES)
+
+
+def _int_producer(ctx, value: ast.AST) -> bool:
+    """Does this expression locally announce an integer dtype?"""
+    if not isinstance(value, ast.Call):
+        return False
+    func = value.func
+    if isinstance(func, ast.Attribute) and func.attr == "astype" \
+            and value.args and _int_dtype_expr(ctx, value.args[0]):
+        return True
+    canon = ctx.canonical(func) or ""
+    if canon.rsplit(".", 1)[-1] == "sum_gh":
+        return True
+    for kw in value.keywords:
+        if kw.arg == "dtype" and _int_dtype_expr(ctx, kw.value):
+            return True
+    return False
+
+
+class DtypeWideningRule(Rule):
+    id = "JLT006"
+    name = "dtype-widening"
+    summary = ("float literal promoting the integer histogram dtype "
+               "in a quantized module")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.is_quant_module:
+            return
+        for scope in walk_scopes(ctx.tree):
+            yield from self._check_scope(ctx, scope)
+
+    def _check_scope(self, ctx, scope) -> Iterator[Finding]:
+        int_names: Set[str] = set()
+        # statement-granular ordering (see jlt001): int-dtype bindings
+        # inside a with/loop/if body must be visible to later
+        # statements of the same block
+        for stmt in iter_statements_ordered(scope.body):
+            nodes = sorted(shallow_walk(stmt),
+                           key=lambda n: (getattr(n, "lineno", 0),
+                                          getattr(n, "col_offset", 0)))
+            for node in nodes:
+                yield from self._check_node(ctx, node, int_names)
+            for node in nodes:
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    tgt = node.targets[0].id
+                    if _int_producer(ctx, node.value):
+                        int_names.add(tgt)
+                    else:
+                        int_names.discard(tgt)
+
+    def _check_node(self, ctx, node, int_names) -> Iterator[Finding]:
+        if isinstance(node, ast.Call):
+            canon = ctx.canonical(node.func) or ""
+            if canon.rsplit(".", 1)[-1] == "where" \
+                    and canon.startswith(("jax.numpy", "jnp")) \
+                    and len(node.args) == 3:
+                a, b = node.args[1], node.args[2]
+                if const_float(a) != const_float(b):
+                    yield self.finding(
+                        ctx, node,
+                        "jnp.where arm is a float literal: it promotes "
+                        "the integer histogram dtype to f32 — use a "
+                        "dtype-preserving neutral element "
+                        "(jnp.zeros((), dtype=x.dtype)) or an int "
+                        "literal")
+        elif isinstance(node, ast.BinOp):
+            l, r = node.left, node.right
+            for lit, other in ((l, r), (r, l)):
+                if const_float(lit) and isinstance(other, ast.Name) \
+                        and other.id in int_names:
+                    yield self.finding(
+                        ctx, node,
+                        "float literal in arithmetic with %r (integer "
+                        "histogram data): the result silently promotes "
+                        "to f32 — dequantize once via "
+                        "ops/quantize.dequantize_hist instead"
+                        % other.id)
+                    break
