@@ -296,6 +296,108 @@ def run_hist_microbench() -> dict:
     return out
 
 
+def run_stream_smoke() -> dict:
+    """Day-long-run telemetry smoke (``python bench.py stream`` or
+    BENCH_STREAM=1): a real traced training run under
+    ``LIGHTGBM_TPU_TRACE_STREAM`` semantics, then a sustained
+    stage-scope emit loop until ≥ BENCH_STREAM_EVENTS trace events
+    (default 2^20 ≈ 4x the old in-memory ``kMaxEvents`` cap) have gone
+    through the streaming spool. Proves the unbounded-length contract:
+    bounded RSS while segments rotate, every segment validating, and
+    the whole directory merging into one Perfetto file via
+    tools/trace_report.py. First-class keys: ``trace_segments_written``
+    and ``trace_dropped_events``."""
+    import importlib.util
+    import resource
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import trace as obs_trace
+    from lightgbm_tpu.obs.registry import registry as obs_registry
+
+    target_events = int(os.environ.get("BENCH_STREAM_EVENTS", 1 << 20))
+    seg_bytes = int(os.environ.get("BENCH_STREAM_SEGMENT_BYTES", 4 << 20))
+    rows = int(os.environ.get("BENCH_STREAM_ROWS", 50_000))
+    iters = int(os.environ.get("BENCH_STREAM_ITERS", 5))
+    out_dir = os.environ.get("BENCH_STREAM_DIR") or tempfile.mkdtemp(
+        prefix="lgbm_tpu_stream_")
+
+    def rss_mb():
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+
+    obs_registry.reset()
+    obs_registry.enable(sampling=True)
+    obs_trace.configure_stream(out_dir, segment_bytes=seg_bytes)
+    _stage("stream_start", dir=out_dir, target_events=target_events)
+
+    # a real traced training run seeds the directory with the full
+    # pipeline's span/instant/counter mix
+    X, y = make_higgs_like(rows, seed=2)
+    t0 = time.time()
+    lgb.train({"objective": "binary", "num_leaves": 63, "max_bin": 255,
+               "verbosity": -1, "min_data_in_leaf": 20},
+              lgb.Dataset(X, label=y), num_boost_round=iters)
+    del X, y
+    _stage("stream_trained", train_secs=round(time.time() - t0, 1))
+
+    # sustained emit through the SAME stage-scope API the pipeline
+    # uses, until the spool has seen the target volume — this is the
+    # day-long-run stand-in (a real run reaches the same count via
+    # ~weeks of train_iter telemetry)
+    t0 = time.time()
+    spool = obs_trace._spool
+    while spool is None or spool.events_emitted < target_events:
+        for _ in range(1024):
+            with obs_registry.scope("stream::sustain"):
+                pass
+        spool = obs_trace._spool
+    obs_trace.flush()
+    emit_secs = time.time() - t0
+    emitted = spool.events_emitted
+    segments = obs_registry.count("trace/segments_written")
+    dropped = obs_registry.count("trace/dropped_events")
+    rss_peak = rss_mb()
+    _stage("stream_emitted", events=emitted, segments=segments,
+           dropped=dropped, emit_secs=round(emit_secs, 1))
+
+    # validate + merge through the real tool (stdlib-only module)
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools",
+            "trace_report.py"))
+    trace_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_report)
+    errors, stats = trace_report.validate_dir(out_dir)
+    merged_path = os.path.join(out_dir, "merged.json")
+    merged = trace_report.merge_traces([out_dir])
+    with open(merged_path, "w") as f:
+        json.dump(merged, f)
+    merge_ok = trace_report.validate_trace(merged, check_parents=False)
+    obs_trace.configure_stream(None)
+    obs_registry.disable()
+    obs_registry.timer.sampling = False
+    _stage("stream_done", validate_errors=len(errors),
+           merged_events=len(merged["traceEvents"]),
+           merge_errors=len(merge_ok))
+    return {
+        "metric": "trace_stream_events_per_sec",
+        "value": round(emitted / max(emit_secs, 1e-9), 1),
+        "unit": "trace events/s through the streaming spool (%d events "
+                "-> %d segments of ~%dMB, %d dropped; peak RSS %d MB; "
+                "validate %s, merged file %s)"
+                % (emitted, segments, seg_bytes >> 20, dropped, rss_peak,
+                   "OK" if not errors else "FAILED",
+                   "OK" if not merge_ok else "FAILED"),
+        "trace_events_emitted": emitted,
+        "trace_segments_written": segments,
+        "trace_dropped_events": dropped,
+        "rss_mb": rss_peak,
+        "validate_ok": not errors,
+        "merge_ok": not merge_ok,
+        "stream_dir": out_dir,
+    }
+
+
 def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
     if n_rows is None:
         n_rows = int(os.environ.get("BENCH_ROWS", HIGGS_ROWS))
@@ -552,6 +654,28 @@ def _run_escalating(platform: str) -> dict:
 
 
 def main() -> None:
+    if (os.environ.get("BENCH_STREAM")
+            or (len(sys.argv) > 1 and sys.argv[1] == "stream")):
+        # streaming-telemetry smoke: CPU is fine (the spool is
+        # host-side), no probe dance needed
+        if os.environ.get("JAX_PLATFORMS") in (None, "") \
+                and not os.environ.get("PALLAS_AXON_POOL_IPS"):
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            result = run_stream_smoke()
+        except Exception as e:
+            result = {"metric": "trace_stream_events_per_sec",
+                      "value": 0.0,
+                      "unit": "events/s (FAILED: %s: %s)"
+                              % (type(e).__name__, str(e)[:300]),
+                      "trace_segments_written": 0,
+                      "trace_dropped_events": 0}
+            print(json.dumps(result))
+            sys.exit(1)
+        print(json.dumps(result))
+        if not (result["validate_ok"] and result["merge_ok"]):
+            sys.exit(1)
+        return
     if (os.environ.get("BENCH_HIST")
             or (len(sys.argv) > 1 and sys.argv[1] == "hist")):
         # standalone histogram microbench: no probe dance — it is cheap

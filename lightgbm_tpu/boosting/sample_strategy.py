@@ -96,6 +96,21 @@ class GOSSStrategy(SampleStrategy):
         self.top_k = max(1, int(num_data * config.top_rate))
         self.other_k = max(1, int(num_data * config.other_rate))
 
+    # _goss passes self as the static jit argument; value-keyed
+    # identity shares the compile across config-identical strategies
+    # (the body bakes top_k / other_k — num_data-derived, so the key
+    # covers both)
+    def __hash__(self):
+        return hash((type(self), self.top_k, self.other_k))
+
+    def __eq__(self, other):
+        return (type(other) is type(self)
+                and (other.top_k, other.other_k)
+                == (self.top_k, self.other_k))
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
     @obs_compile.instrument_jit_method("boost.goss")
     def _goss(self, grad, hess, key):
         # grad/hess: [N] or [N, K]

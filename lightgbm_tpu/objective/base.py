@@ -37,6 +37,43 @@ class ObjectiveFunction:
         self.weights: Optional[jnp.ndarray] = None
 
     # ------------------------------------------------------------------
+    # Compile sharing across instances. The objectives' jitted methods
+    # (``instrument_jit_method``) pass ``self`` as the STATIC argument,
+    # so jax keys its compile cache on ``hash(self)``/``==``. Default
+    # object identity means every instance compiles its own copy of an
+    # identical gradient program — one wasted compile per lgb.train()
+    # call (and K per MulticlassOVA). Objectives that declare a
+    # ``_jit_key()`` opt in to value-keyed identity instead: two
+    # instances with equal keys share one compiled executable.
+    #
+    # CONTRACT: ``_jit_key()`` must cover EVERY value the class's
+    # jitted bodies read off ``self`` — those values are baked into the
+    # compiled program as constants at trace time, so two key-equal
+    # instances MUST trace identically. Arrays (labels, weights,
+    # lookup tables) are safe only when passed as traced arguments or
+    # when their content is a pure function of the key.
+    def _jit_key(self):
+        """Hashable static identity for the jit cache; None (the
+        default) keeps object-identity semantics — correct for any
+        subclass whose jitted bodies read arbitrary instance state."""
+        return None
+
+    def __hash__(self):
+        k = self._jit_key()
+        if k is None:
+            return object.__hash__(self)
+        return hash((type(self), k))
+
+    def __eq__(self, other):
+        k = self._jit_key()
+        if k is None:
+            return self is other
+        return type(other) is type(self) and other._jit_key() == k
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    # ------------------------------------------------------------------
     def init(self, metadata, num_data: int) -> None:
         """Bind training metadata (reference: ObjectiveFunction::Init)."""
         self.num_data = num_data

@@ -72,6 +72,12 @@ class BinaryLogloss(ObjectiveFunction):
             np.where(is_pos, pos_weight, neg_weight).astype(np.float32))
         self._is_pos_np = is_pos
 
+    def _jit_key(self):
+        # the gradient body reads only self.sigmoid (label sign/weight
+        # are traced args), so config-identical instances — including
+        # MulticlassOVA's K per-class objectives — share one compile
+        return (self.sigmoid,)
+
     @obs_compile.instrument_jit_method("obj.binary.grads")
     def _grads(self, score, label_sign, label_weight, weights):
         response = (-label_sign * self.sigmoid

@@ -52,6 +52,9 @@ class MulticlassSoftmax(ObjectiveFunction):
         self.label_onehot = jnp.asarray(
             np.eye(self.num_class, dtype=np.float32)[li])
 
+    def _jit_key(self):
+        return (self.num_class,)  # the body bakes self.factor = K/(K-1)
+
     @obs_compile.instrument_jit_method("obj.multiclass.grads")
     def _grads(self, score, label_onehot, weights):
         p = jax.nn.softmax(score, axis=1)

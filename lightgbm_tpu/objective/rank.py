@@ -110,6 +110,19 @@ class LambdarankNDCG(ObjectiveFunction):
         self.discount_table = jnp.asarray(
             dcg.discounts(max(L, 1)).astype(np.float32))
 
+    def _jit_key(self):
+        # the lambda body bakes sigmoid/norm/truncation plus the
+        # gain/discount table CONTENTS — gain = label_gain, discount =
+        # discounts(max query length) — so the key must pin all of
+        # them; pre-init (no layout yet) instances fall back to
+        # identity semantics (None = the base-class default)
+        layout = getattr(self, "layout", None)
+        if layout is None:
+            return None
+        return (self.sigmoid, self.norm, self.truncation_level,
+                tuple(float(g) for g in self.label_gain),
+                layout.max_len)
+
     # ------------------------------------------------------------------
     def _query_lambdas(self, labels, scores, mask, inv_max_dcg):
         """One query's lambdas/hessians over padded [L] arrays."""
@@ -227,6 +240,9 @@ class RankXENDCG(ObjectiveFunction):
         if metadata.query_boundaries is None:
             log.fatal("Ranking tasks require query information")
         self.layout = QueryLayout(metadata.query_boundaries, num_data)
+
+    def _jit_key(self):
+        return ()  # the body reads nothing off self
 
     def _query_grads(self, labels, scores, mask, unif):
         neg_inf = jnp.float32(-1e30)

@@ -37,6 +37,11 @@ class RegressionL2(ObjectiveFunction):
         self.sqrt = bool(config.reg_sqrt)
         self._raw_label: Optional[np.ndarray] = None
 
+    def _jit_key(self):
+        # the L2/L1/MAPE gradient bodies read nothing off self — every
+        # config-identical instance shares one compile per score shape
+        return ()
+
     @property
     def is_constant_hessian(self) -> bool:
         return self.weights is None
@@ -140,6 +145,9 @@ class RegressionHuber(RegressionL2):
         if self.alpha <= 0.0:
             log.fatal("alpha should be greater than 0")
 
+    def _jit_key(self):
+        return (self.alpha,)  # baked into the clip constants
+
     @obs_compile.instrument_jit_method("obj.huber.grads")
     def _grads(self, score, label, weights):
         diff = score - label
@@ -159,6 +167,9 @@ class RegressionFair(RegressionL2):
         super().__init__(config)
         self.sqrt = False
         self.c = float(config.fair_c)
+
+    def _jit_key(self):
+        return (self.c,)
 
     @property
     def is_constant_hessian(self) -> bool:
@@ -190,6 +201,11 @@ class RegressionPoisson(RegressionL2):
         self.max_delta_step = float(config.poisson_max_delta_step)
         if self.max_delta_step <= 0.0:
             log.fatal("poisson_max_delta_step should be greater than 0")
+
+    def _jit_key(self):
+        # covers Gamma too (its body reads nothing; keying the shared
+        # scalar is merely conservative)
+        return (self.max_delta_step,)
 
     def _check_label(self, label: np.ndarray) -> None:
         if (label < 0).any():
@@ -230,6 +246,9 @@ class RegressionQuantile(RegressionL2):
         self.alpha = float(config.alpha)
         if not (0.0 < self.alpha < 1.0):
             log.fatal("alpha should be in (0, 1) for quantile objective")
+
+    def _jit_key(self):
+        return (self.alpha,)
 
     @property
     def is_constant_hessian(self) -> bool:
@@ -337,6 +356,9 @@ class RegressionTweedie(RegressionPoisson):
     def __init__(self, config):
         super().__init__(config)
         self.rho = float(config.tweedie_variance_power)
+
+    def _jit_key(self):
+        return (self.max_delta_step, self.rho)
 
     def _check_label(self, label: np.ndarray) -> None:
         if (label < 0).any():

@@ -39,6 +39,9 @@ class CrossEntropy(ObjectiveFunction):
             if w.sum() == 0.0:
                 log.fatal("[%s]: sum of weights is zero" % self.name)
 
+    def _jit_key(self):
+        return ()  # the body reads nothing off self
+
     @obs_compile.instrument_jit_method("obj.xentropy.grads")
     def _grads(self, score, label, weights):
         z = jax.nn.sigmoid(score)
@@ -87,6 +90,9 @@ class CrossEntropyLambda(ObjectiveFunction):
             if (w <= 0).any():
                 log.fatal("[%s]: at least one weight is non-positive"
                           % self.name)
+
+    def _jit_key(self):
+        return ()  # the body reads nothing off self
 
     @obs_compile.instrument_jit_method("obj.xentropy_lambda.grads")
     def _grads(self, score, label, weights):

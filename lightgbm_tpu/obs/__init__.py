@@ -15,7 +15,12 @@ at exit under -DUSE_TIMETAG). This package is the TPU-native superset:
 - :mod:`compile`   — XLA compile/retrace tracking per jitted function,
   plus opt-in ``lower().cost_analysis()`` capture (FLOPs / bytes / HLO
   size on the ``jit_trace`` event).
-- :mod:`health`    — backend selection / fallback events.
+- :mod:`health`    — backend selection / fallback events, plus the SLO
+  :class:`~lightgbm_tpu.obs.health.Watchdog` (threshold rules over the
+  snapshot stream, one ``health`` event per breach).
+- :mod:`export`    — OpenMetrics-style snapshot rendering: periodic
+  file dumps (``LIGHTGBM_TPU_METRICS=path``) and the HTTP ``/metrics``
+  listener the serving plane mounts.
 - :mod:`trace`     — span tracing layered onto the scopes and events
   above, exported as Chrome-trace/Perfetto JSON
   (``LIGHTGBM_TPU_TRACE=path.json``), with the async readiness drainer
@@ -33,6 +38,7 @@ from . import compile as compile_tracking  # noqa: F401
 from . import events, health  # noqa: F401
 from .registry import MetricsRegistry, StageTimer, registry  # noqa: F401
 from . import trace  # noqa: F401  (installs the span hooks/taps)
+from . import export  # noqa: F401  (OpenMetrics snapshots + /metrics)
 
 scope = registry.scope
 counter = registry.inc
@@ -42,6 +48,6 @@ watch_ready = registry.watch_ready
 
 __all__ = [
     "MetricsRegistry", "StageTimer", "registry", "events", "health",
-    "compile_tracking", "trace", "scope", "counter", "gauge", "observe",
-    "watch_ready",
+    "compile_tracking", "trace", "export", "scope", "counter", "gauge",
+    "observe", "watch_ready",
 ]

@@ -1,0 +1,393 @@
+"""Metrics snapshot export: OpenMetrics text, periodic dumps, /metrics.
+
+The registry (counters / gauges / histograms / stage timer) is a pull
+model — everything this module does is *render* one consistent
+``registry.snapshot()`` into the OpenMetrics-style text format and move
+it somewhere a consumer can reach:
+
+- :func:`render_openmetrics` — the serializer (stdlib-only, no jax);
+  `serve/latency_ms` style histogram names come out as summary
+  families with ``quantile`` labels, per-function compile/retrace
+  telemetry as labeled families (``...jit_traces_total{fn="..."}``),
+  the stage timer as ``stage_seconds_total{stage="..."}``.
+- :func:`parse_openmetrics` — the matching reader (round-trip tested;
+  also what the watchdog tests use to assert the exported numbers).
+- :func:`dump_metrics` — one-shot ATOMIC file dump (tmp + rename), for
+  training runs that want snapshots without an HTTP listener.
+- :class:`SnapshotExporter` — a daemon thread re-dumping every
+  ``interval`` seconds and running the SLO watchdog
+  (:class:`obs.health.Watchdog`) over each snapshot. Enabled by
+  ``LIGHTGBM_TPU_METRICS=path`` (+ ``LIGHTGBM_TPU_METRICS_INTERVAL``,
+  seconds, default 10) via :func:`tick`, which the boosting drivers
+  call once per iteration (`obs/trace.sample_iteration`).
+- :class:`MetricsHTTPServer` — a ``/metrics`` (+ ``/healthz``) HTTP
+  listener over the same renderer; ``serve/server.py PredictServer``
+  mounts it with ``metrics_port=...`` so a serving fleet is scrapable
+  under load.
+
+Everything here is best-effort and never raises into the caller:
+telemetry must not take training or serving down.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import re
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from . import events as _events
+from .registry import registry
+
+_ENV_PATH = "LIGHTGBM_TPU_METRICS"
+_ENV_INTERVAL = "LIGHTGBM_TPU_METRICS_INTERVAL"
+_ENV_WATCHDOG = "LIGHTGBM_TPU_WATCHDOG"
+
+kPrefix = "lightgbm_tpu_"
+kDefaultIntervalS = 10.0
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _san(name: str) -> str:
+    s = _NAME_RE.sub("_", name)
+    if not s or s[0].isdigit():
+        s = "_" + s
+    return s
+
+
+def _esc(label_value: str) -> str:
+    return (str(label_value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt(v) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_openmetrics(reg=registry) -> str:
+    """Serialize one consistent registry snapshot as OpenMetrics-style
+    text (``# TYPE`` headers, ``{label="..."}`` pairs, ``# EOF``
+    terminator). Families:
+
+    - counters → ``<name>_total`` (``jit_trace/<fn>`` folds into one
+      ``jit_traces_total{fn="..."}`` family);
+    - numeric gauges → gauges (``compile/<fn>/<metric>`` folds into
+      ``compile_<metric>{fn="..."}``); non-numeric gauges (``backend``)
+      → ``<name>_info{value="..."} 1``;
+    - histograms (``registry.observe``) → summary families with
+      ``quantile="0.5"/"0.99"`` samples + ``_count``;
+    - the stage timer → ``stage_seconds_total{stage=...}`` /
+      ``stage_calls_total{stage=...}`` /
+      ``stage_duration_ms{stage=...,quantile=...}``.
+    """
+    snap = reg.snapshot()
+    out = []
+
+    counters = snap.get("counters", {})
+    plain = {k: v for k, v in counters.items()
+             if not k.startswith("jit_trace/")}
+    jit = {k[len("jit_trace/"):]: v for k, v in counters.items()
+           if k.startswith("jit_trace/")}
+    for name, v in sorted(plain.items()):
+        m = kPrefix + _san(name) + "_total"
+        out.append("# TYPE %s counter" % m)
+        out.append("%s %s" % (m, _fmt(v)))
+    if jit:
+        m = kPrefix + "jit_traces_total"
+        out.append("# TYPE %s counter" % m)
+        for fn, v in sorted(jit.items()):
+            out.append('%s{fn="%s"} %s' % (m, _esc(fn), _fmt(v)))
+
+    gauges = snap.get("gauges", {})
+    compile_g: Dict[str, Dict[str, float]] = {}
+    for name, v in sorted(gauges.items()):
+        if name.startswith("compile/"):
+            parts = name.split("/")
+            if len(parts) == 3:
+                compile_g.setdefault(parts[2], {})[parts[1]] = v
+                continue
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            m = kPrefix + _san(name)
+            out.append("# TYPE %s gauge" % m)
+            out.append("%s %s" % (m, _fmt(v)))
+        else:
+            m = kPrefix + _san(name) + "_info"
+            out.append("# TYPE %s gauge" % m)
+            out.append('%s{value="%s"} 1' % (m, _esc(v)))
+    for metric, by_fn in sorted(compile_g.items()):
+        m = kPrefix + "compile_" + _san(metric)
+        out.append("# TYPE %s gauge" % m)
+        for fn, v in sorted(by_fn.items()):
+            out.append('%s{fn="%s"} %s' % (m, _esc(fn), _fmt(v)))
+
+    for name, h in sorted(snap.get("hists", {}).items()):
+        m = kPrefix + _san(name)
+        out.append("# TYPE %s summary" % m)
+        out.append('%s{quantile="0.5"} %s' % (m, _fmt(h["p50"])))
+        out.append('%s{quantile="0.99"} %s' % (m, _fmt(h["p99"])))
+        out.append("%s_count %s" % (m, _fmt(h["count"])))
+
+    phases = snap.get("phases", {})
+    if phases:
+        sec = kPrefix + "stage_seconds_total"
+        calls = kPrefix + "stage_calls_total"
+        dur = kPrefix + "stage_duration_ms"
+        out.append("# TYPE %s counter" % sec)
+        for stage, e in sorted(phases.items()):
+            out.append('%s{stage="%s"} %s'
+                       % (sec, _esc(stage), _fmt(e["seconds"])))
+        out.append("# TYPE %s counter" % calls)
+        for stage, e in sorted(phases.items()):
+            out.append('%s{stage="%s"} %s'
+                       % (calls, _esc(stage), _fmt(e["calls"])))
+        out.append("# TYPE %s summary" % dur)
+        for stage, e in sorted(phases.items()):
+            if "p50_ms" in e:
+                out.append('%s{stage="%s",quantile="0.5"} %s'
+                           % (dur, _esc(stage), _fmt(e["p50_ms"])))
+                out.append('%s{stage="%s",quantile="0.99"} %s'
+                           % (dur, _esc(stage), _fmt(e["p99_ms"])))
+    out.append("# EOF")
+    return "\n".join(out) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_][a-zA-Z0-9_]*)(?:\{(.*)\})?\s+(\S+)\s*$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+Sample = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def parse_openmetrics(text: str) -> Dict[Sample, float]:
+    """Parse OpenMetrics-style text back into
+    ``{(name, ((label, value), ...)): float}``. Raises ValueError on a
+    malformed sample line — the round-trip tests depend on strictness."""
+    out: Dict[Sample, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError("malformed sample line: %r" % line)
+        name, labels_raw, value = m.groups()
+        labels = []
+        if labels_raw:
+            matched = _LABEL_RE.findall(labels_raw)
+            stripped = _LABEL_RE.sub("", labels_raw).replace(",", "").strip()
+            if stripped:
+                raise ValueError("malformed labels: %r" % labels_raw)
+            # single left-to-right scan: sequential .replace() passes
+            # would let an escaped backslash donate its second half to
+            # a following 'n' or '"' (r'C:\\nightly' -> 'C:\' + \n)
+            unesc = re.compile(r"\\(.)")
+            labels = [(k, unesc.sub(
+                lambda m: "\n" if m.group(1) == "n" else m.group(1), v))
+                for k, v in matched]
+        out[(name, tuple(sorted(labels)))] = float(value)
+    return out
+
+
+def metric_value(parsed: Dict[Sample, float], name: str,
+                 **labels) -> Optional[float]:
+    """Convenience lookup into :func:`parse_openmetrics` output."""
+    key = (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+    return parsed.get(key)
+
+
+def dump_metrics(path: str, reg=registry) -> None:
+    """One-shot atomic snapshot dump. Never raises."""
+    try:
+        text = render_openmetrics(reg)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except Exception:
+        pass
+
+
+# ----------------------------------------------------------------------
+# periodic exporter + watchdog tick
+# ----------------------------------------------------------------------
+
+class SnapshotExporter:
+    """Daemon thread: every ``interval`` seconds, atomically rewrite
+    ``path`` with the current OpenMetrics text and run the SLO watchdog
+    over the same snapshot. ``interval=0`` disables the thread — dumps
+    then happen only on :meth:`dump_now` / atexit."""
+
+    def __init__(self, path: str, interval: float = kDefaultIntervalS,
+                 reg=registry, watchdog=None) -> None:
+        from .health import Watchdog
+        self.path = path
+        self.interval = max(float(interval), 0.0)
+        self.reg = reg
+        self.watchdog = watchdog if watchdog is not None else Watchdog(reg)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._atexit_registered = False
+
+    def start(self) -> "SnapshotExporter":
+        if self.interval > 0 and (self._thread is None
+                                  or not self._thread.is_alive()):
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="obs-metrics-exporter", daemon=True)
+            self._thread.start()
+        if not self._atexit_registered:
+            self._atexit_registered = True
+            atexit.register(self.dump_now)
+        return self
+
+    def stop(self) -> None:
+        """Stop the thread AND detach the atexit dump — a stopped
+        (replaced) exporter must not re-dump post-stop registry state
+        over its old path at interpreter exit."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._atexit_registered:
+            self._atexit_registered = False
+            try:
+                atexit.unregister(self.dump_now)
+            except Exception:
+                pass
+
+    def dump_now(self) -> None:
+        try:
+            snap = self.reg.snapshot()
+            self.watchdog.evaluate(snap)
+        except Exception:
+            pass
+        dump_metrics(self.path, self.reg)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.dump_now()
+
+
+_exporter: Optional[SnapshotExporter] = None
+_inline_watchdog = None
+_lock = threading.Lock()
+
+
+def tick(reg=registry) -> None:
+    """Per-iteration hook (called from ``obs/trace.sample_iteration``):
+    starts the env-configured exporter once, and — when no file
+    exporter is running but ``LIGHTGBM_TPU_WATCHDOG`` asks for it —
+    evaluates the default watchdog inline so event-log-only runs still
+    get ``health`` events. Cheap when neither env var is set."""
+    global _exporter, _inline_watchdog
+    path = os.environ.get(_ENV_PATH)
+    if path and _exporter is None:
+        with _lock:
+            if _exporter is None:
+                try:
+                    interval = float(os.environ.get(
+                        _ENV_INTERVAL, kDefaultIntervalS))
+                except ValueError:
+                    interval = kDefaultIntervalS
+                _exporter = SnapshotExporter(path, interval,
+                                             reg).start()
+    if _exporter is not None:
+        return
+    wd = os.environ.get(_ENV_WATCHDOG, "")
+    if wd.strip().lower() in ("", "0", "false", "off"):
+        return
+    if _inline_watchdog is None:
+        with _lock:
+            if _inline_watchdog is None:
+                from .health import Watchdog
+                _inline_watchdog = Watchdog(reg)
+    try:
+        _inline_watchdog.evaluate()
+    except Exception:
+        pass
+
+
+def reset_exporter() -> None:
+    """Detach the env-driven exporter/watchdog singletons (tests)."""
+    global _exporter, _inline_watchdog
+    with _lock:
+        if _exporter is not None:
+            _exporter.stop()
+        _exporter = None
+        _inline_watchdog = None
+
+
+# ----------------------------------------------------------------------
+# /metrics HTTP listener
+# ----------------------------------------------------------------------
+
+class MetricsHTTPServer:
+    """Minimal stdlib HTTP listener for scraping:
+
+    - ``GET /metrics``  → OpenMetrics text (the renderer above);
+    - ``GET /healthz``  → JSON ``registry.snapshot()`` plus the
+      watchdog's currently-breached rules.
+
+    Binds ``host:port`` (``port=0`` picks a free ephemeral port —
+    read it back from ``.port``); serves from a daemon thread. The
+    request handler reads ONE consistent snapshot per request and
+    never raises into the socket loop."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 reg=registry, watchdog=None) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        self.reg = reg
+        self.watchdog = watchdog
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                try:
+                    if self.path.split("?")[0] == "/metrics":
+                        body = render_openmetrics(outer.reg).encode()
+                        ctype = "text/plain; charset=utf-8"
+                    elif self.path.split("?")[0] == "/healthz":
+                        doc = {"snapshot": outer.reg.snapshot()}
+                        if outer.watchdog is not None:
+                            doc["breached"] = outer.watchdog.breached()
+                        body = (json.dumps(doc) + "\n").encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception:
+                    self.send_error(500)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes must not spam stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="obs-metrics-http", daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return "http://%s:%d" % (self.host, self.port)
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)

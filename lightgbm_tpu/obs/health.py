@@ -1,4 +1,4 @@
-"""Backend health events: selection, fallback, degradation.
+"""Backend health events + SLO watchdogs.
 
 Round-5 evidence (BENCH_r05.json) motivated this module: a silent CPU
 fallback — "tpu backend probe failed/timed out (3 attempts)" — whose
@@ -9,10 +9,20 @@ now a first-class, machine-readable event:
   once per process at first training.
 - ``backend_fallback`` — a requested accelerator degraded to another
   platform, with the reason; always mirrored as a Warning log line.
+
+:class:`Watchdog` runs threshold rules over the registry snapshot
+stream (obs/export.py feeds it one snapshot per exporter tick) and
+emits a structured ``health`` event EXACTLY ONCE per breach: a rule
+fires on the false→true transition of its condition and re-arms when
+the condition clears, so a saturated queue produces one event, not one
+per snapshot. Default rules: retrace spike (jit trace-count delta per
+interval), backend fallback, serve queue-depth saturation, and trace
+drop counters (spool + readiness drainer).
 """
 from __future__ import annotations
 
-from typing import Optional
+import os
+from typing import Callable, Dict, List, Optional
 
 from ..utils import log
 from . import events
@@ -64,3 +74,150 @@ def record_backend_fallback(reason: str, requested: str = "tpu",
     events.emit("backend_fallback", requested=requested, actual=actual,
                 reason=reason)
     events.flush()  # degradation evidence must survive a crash
+
+
+# ----------------------------------------------------------------------
+# SLO watchdogs over the snapshot stream
+# ----------------------------------------------------------------------
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class WatchRule:
+    """One threshold rule: ``check(snapshot, state)`` returns a detail
+    dict while the condition holds, else None. ``state`` is a per-rule
+    dict the rule may use for counter deltas across snapshots."""
+
+    def __init__(self, name: str,
+                 check: Callable[[dict, dict], Optional[dict]]) -> None:
+        self.name = name
+        self.check = check
+
+
+def _counter_delta(snap: dict, state: dict, match, state_key: str,
+                   first_is_baseline: bool) -> float:
+    """Delta of the summed counters since the previous snapshot. With
+    ``first_is_baseline`` the first observation arms the rule without
+    firing (retrace watch: warm-up compiles are normal); without it the
+    baseline is 0, so pre-existing occurrences fire on first look
+    (fallback / drops: already-degraded is still degraded)."""
+    counters = snap.get("counters", {})
+    total = float(sum(v for k, v in counters.items()
+                      if (k in match if isinstance(match, (set, frozenset))
+                          else k.startswith(match))))
+    if state_key not in state:
+        state[state_key] = total if first_is_baseline else 0.0
+    delta = total - state[state_key]
+    state[state_key] = total
+    return delta
+
+
+def default_rules() -> List[WatchRule]:
+    """The stock SLO rules. Thresholds are env-tunable:
+
+    - ``LIGHTGBM_TPU_WATCH_RETRACE_SPIKE`` (default 8): total new jit
+      traces between two snapshots at or above this = a retrace storm
+      (steady state should re-trace ~never);
+    - ``LIGHTGBM_TPU_WATCH_QUEUE_DEPTH`` (default 1024): serve queue
+      depth at or above this = admission saturation;
+    - backend fallback and trace drops fire on ANY new occurrence.
+    """
+    retrace_thr = _env_float("LIGHTGBM_TPU_WATCH_RETRACE_SPIKE", 8)
+    queue_thr = _env_float("LIGHTGBM_TPU_WATCH_QUEUE_DEPTH", 1024)
+
+    def retrace_spike(snap, state):
+        delta = _counter_delta(snap, state, "jit_trace/", "prev",
+                               first_is_baseline=True)
+        if delta >= retrace_thr:
+            return {"value": delta, "threshold": retrace_thr,
+                    "detail": "%d new jit traces in one snapshot "
+                              "interval" % delta}
+        return None
+
+    def backend_fallback(snap, state):
+        delta = _counter_delta(snap, state,
+                               frozenset(("backend_fallback",)), "prev",
+                               first_is_baseline=False)
+        if delta > 0:
+            return {"value": delta, "threshold": 1,
+                    "detail": "backend fallback recorded"}
+        return None
+
+    def queue_saturation(snap, state):
+        depth = float(snap.get("gauges", {}).get("serve/queue_depth", 0))
+        if depth >= queue_thr:
+            return {"value": depth, "threshold": queue_thr,
+                    "detail": "serve queue depth saturated"}
+        return None
+
+    def trace_drops(snap, state):
+        # trace/dropped_events covers both sinks: the streaming
+        # spool's backlog-full chunk drops and the bounded single-file
+        # buffer's overflow (the per-stream readiness drainer cannot
+        # drop — coalescing caps each stream at one in-flight watch)
+        delta = _counter_delta(
+            snap, state, frozenset(("trace/dropped_events",)),
+            "prev", first_is_baseline=False)
+        if delta > 0:
+            return {"value": delta, "threshold": 1,
+                    "detail": "trace events dropped (spool backlog "
+                              "full or span buffer overflow)"}
+        return None
+
+    return [WatchRule("retrace_spike", retrace_spike),
+            WatchRule("backend_fallback", backend_fallback),
+            WatchRule("queue_saturation", queue_saturation),
+            WatchRule("trace_drops", trace_drops)]
+
+
+class Watchdog:
+    """Evaluate threshold rules over successive registry snapshots,
+    emitting one ``health`` event per breach (false→true transition;
+    the rule re-arms when its condition clears). Each firing also
+    increments the ``health/<rule>`` counter, so breaches are visible
+    in the very /metrics stream being watched."""
+
+    def __init__(self, reg=registry,
+                 rules: Optional[List[WatchRule]] = None) -> None:
+        self.reg = reg
+        self.rules = rules if rules is not None else default_rules()
+        self._state: Dict[str, dict] = {}
+        self._breached: Dict[str, bool] = {}
+        self._last_fired: Dict[str, dict] = {}
+
+    def evaluate(self, snapshot: Optional[dict] = None) -> List[dict]:
+        """Run every rule against ``snapshot`` (default: a fresh
+        ``reg.snapshot()``); returns the list of NEW breaches fired
+        this evaluation. Never raises."""
+        if snapshot is None:
+            snapshot = self.reg.snapshot()
+        fired: List[dict] = []
+        for rule in self.rules:
+            try:
+                detail = rule.check(snapshot,
+                                    self._state.setdefault(rule.name, {}))
+            except Exception:
+                continue
+            breached = detail is not None
+            if breached and not self._breached.get(rule.name, False):
+                rec = dict(rule=rule.name, severity="warning", **detail)
+                self._last_fired[rule.name] = rec
+                fired.append(rec)
+                self.reg.inc("health/" + rule.name)
+                log.warning("health watchdog: %s — %s"
+                            % (rule.name, detail.get("detail", "")))
+                events.emit("health", **rec)
+            self._breached[rule.name] = breached
+        if fired:
+            events.flush()  # breach evidence must survive a crash
+        return fired
+
+    def breached(self) -> List[dict]:
+        """Rules currently in breach (for /healthz)."""
+        return [self._last_fired[name]
+                for name, b in sorted(self._breached.items())
+                if b and name in self._last_fired]

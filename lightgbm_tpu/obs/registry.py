@@ -45,8 +45,11 @@ _profiler_mod = None
 kHistCap = 4096
 
 # Trace-layer hooks, installed by obs/trace.py (registry stays importable
-# standalone; the hook object must expose active()/begin(name)/end(token)
-# and ready_span(name, t0_perf, t1_perf)).
+# standalone; the hook object must expose active()/begin(name)/end(token),
+# ready_span(name, t0_perf, t1_perf, queued_s, for_span) and
+# current_span() — the span id open on the calling thread, the token
+# that lets the readiness drainer land device time on the exact
+# emitting span).
 _trace_hooks = None
 
 # Reset hooks: callables run on MetricsRegistry.reset() so module-global
@@ -191,55 +194,82 @@ class StageTimer:
 class _ReadyWatcher:
     """Async stage-output readiness drainer (the non-perturbing
     replacement for TIMETAG's fences): the hot path enqueues a stage's
-    output array and keeps dispatching; this daemon thread
-    ``block_until_ready``s each item in order and attributes the
+    output array and keeps dispatching; a daemon thread
+    ``block_until_ready``s it off the hot path and attributes the
     remaining device time under ``<stage>::ready`` (plus a span on the
     trace's device-readiness lane).
+
+    Attribution is PER STREAM: each watched stage name gets its own
+    drainer thread, so two stages whose outputs are in flight
+    concurrently (serve worker vs trainer, or overlapped pipeline
+    stages) each measure ONLY their own readiness — the old single
+    FIFO thread serialized the waits, folding stage A's wait into
+    stage B's span whenever B finished first. Each watch also carries
+    the span id that was open at submit time, so the ``::ready`` span
+    parent-links to the exact emitting span instead of whichever span
+    the FIFO happened to pair it with.
 
     At most ONE watch per stage name is in flight: a queued watch pins
     its output buffer alive (at Higgs scale the gh matrix alone is
     ~170 MB), so when the host runs ahead of the device further watches
     of the same stage are coalesced — counted under
     ``trace/ready_coalesced`` — rather than accumulating buffer
-    references. Readiness is therefore a SAMPLE of iterations, which is
-    exactly the mode's contract; the hot path never blocks."""
+    references (total pinned = one buffer per distinct watched stage).
+    Readiness is therefore a SAMPLE of iterations, which is exactly the
+    mode's contract; the hot path never blocks."""
 
-    kQueueCap = 64
+    kMaxStreams = 64
 
     def __init__(self) -> None:
-        self._q = None
         self._lock = threading.Lock()
         self._inflight = set()
+        self._streams: Dict[str, object] = {}  # name -> queue.Queue
+        self._unfinished = 0
 
-    def _ensure_thread(self):
-        if self._q is None:
+    def _stream(self, name: str):
+        q = self._streams.get(name)
+        if q is None:
             with self._lock:
-                if self._q is None:
+                q = self._streams.get(name)
+                if q is None:
                     import queue
-                    self._q = queue.Queue(maxsize=self.kQueueCap)
-                    t = threading.Thread(target=self._run,
-                                         name="obs-ready-drainer",
-                                         daemon=True)
-                    t.start()
-        return self._q
+                    if len(self._streams) >= self.kMaxStreams:
+                        # runaway distinct names (a bug upstream) must
+                        # not spawn unbounded threads: fold the excess
+                        # into one shared overflow stream
+                        q = self._streams.get("<overflow>")
+                        if q is None:
+                            q = self._spawn("<overflow>")
+                            self._streams["<overflow>"] = q
+                        self._streams[name] = q
+                    else:
+                        q = self._spawn(name)
+                        self._streams[name] = q
+        return q
 
-    def submit(self, name: str, value, reg: "MetricsRegistry") -> None:
-        q = self._ensure_thread()
+    def _spawn(self, name: str):
+        import queue
+        q = queue.Queue()
+        t = threading.Thread(target=self._run, args=(q,),
+                             name="obs-ready-drainer:" + name,
+                             daemon=True)
+        t.start()
+        return q
+
+    def submit(self, name: str, value, reg: "MetricsRegistry",
+               span_id: int = 0) -> None:
+        q = self._stream(name)
         with self._lock:
             if name in self._inflight:
                 reg.inc("trace/ready_coalesced")
                 return
             self._inflight.add(name)
-        try:
-            q.put_nowait((name, value, time.perf_counter(), reg))
-        except Exception:
-            with self._lock:
-                self._inflight.discard(name)
-            reg.inc("trace/ready_dropped")
+            self._unfinished += 1
+        q.put((name, value, time.perf_counter(), reg, span_id))
 
-    def _run(self) -> None:
+    def _run(self, q) -> None:
         while True:
-            name, value, t_submit, reg = self._q.get()
+            name, value, t_submit, reg, span_id = q.get()
             try:
                 import jax
                 t_wait0 = time.perf_counter()
@@ -249,11 +279,12 @@ class _ReadyWatcher:
                     reg.timer.record(name + "::ready", t_ready - t_submit)
                 h = _trace_hooks
                 if h is not None and h.active():
-                    # span from wait-start (not submit): the drainer
-                    # serializes waits, so lane spans stay disjoint; the
+                    # span from wait-start (not submit): per-stream
+                    # threads keep each lane's spans disjoint; the
                     # queue delay rides along as an arg
                     h.ready_span(name, t_wait0, t_ready,
-                                 queued_s=t_wait0 - t_submit)
+                                 queued_s=t_wait0 - t_submit,
+                                 for_span=span_id)
             except Exception:
                 # a donated/deleted buffer or backend error must never
                 # kill telemetry
@@ -262,21 +293,20 @@ class _ReadyWatcher:
                 del value
                 with self._lock:
                     self._inflight.discard(name)
-                self._q.task_done()
+                    self._unfinished -= 1
 
     def drain(self, timeout: float = 10.0) -> bool:
         """Best-effort wait for all watched outputs to resolve (used
         before trace export / summary printing). Returns False on
         timeout — a wedged device must not wedge telemetry too."""
-        q = self._q
-        if q is None:
-            return True
         deadline = time.perf_counter() + timeout
-        while q.unfinished_tasks:
+        while True:
+            with self._lock:
+                if self._unfinished == 0:
+                    return True
             if time.perf_counter() >= deadline:
                 return False
             time.sleep(0.001)
-        return True
 
 
 _ready_watcher = _ReadyWatcher()
@@ -353,7 +383,13 @@ class MetricsRegistry:
             jax.block_until_ready(value)
             return
         if self.timer.sampling or tracing:
-            _ready_watcher.submit(name, value, self)
+            span_id = 0
+            if tracing:
+                try:
+                    span_id = _trace_hooks.current_span()
+                except Exception:
+                    span_id = 0
+            _ready_watcher.submit(name, value, self, span_id=span_id)
 
     def drain_ready(self, timeout: float = 10.0) -> bool:
         """Wait for the readiness drainer's queue to empty."""

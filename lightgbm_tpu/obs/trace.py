@@ -32,6 +32,21 @@ The span buffer is in-memory and bounded (``kMaxEvents``); it is
 written on :func:`flush` (registered atexit), on :func:`configure`,
 and the export rewrites the whole file — partial JSON is never left
 behind.
+
+For runs of unbounded length, ``LIGHTGBM_TPU_TRACE_STREAM=dir`` (or
+:func:`configure_stream`) replaces the single bounded buffer with a
+STREAMING SPOOL: events stage in a small in-memory chunk, a writer
+thread serializes chunks off the hot path, and whenever the current
+segment reaches ``LIGHTGBM_TPU_TRACE_SEGMENT_BYTES`` (default 8 MiB)
+it is finalized ATOMICALLY (tmp + rename) as
+``segment-r<rank>-<seq>.json`` — a self-contained Chrome-trace file —
+inside the directory. Memory stays bounded at (staging chunk + writer
+backlog + one segment); when the writer backlog is full, whole chunks
+are dropped and counted under ``trace/dropped_events`` instead of
+growing RSS. ``tools/trace_report.py`` validates / merges / summarizes
+/ tails segment directories. Flush (atexit, ``log.fatal``,
+:func:`configure`) finalizes the partial tail segment, so the on-disk
+directory never holds invalid JSON.
 """
 from __future__ import annotations
 
@@ -50,13 +65,24 @@ from .registry import install_trace_hooks as _install_trace_hooks
 from .registry import registry
 
 _ENV_VAR = "LIGHTGBM_TPU_TRACE"
+_ENV_STREAM = "LIGHTGBM_TPU_TRACE_STREAM"
+_ENV_SEGMENT_BYTES = "LIGHTGBM_TPU_TRACE_SEGMENT_BYTES"
 
 kMaxEvents = 1 << 18
+kDefaultSegmentBytes = 8 << 20
+# streaming spool: hot-path staging chunk size and writer backlog cap
+# (chunks). Memory in flight is bounded by
+# stage_events * (1 + max_pending) events + one serialized segment.
+kStreamStageEvents = 1024
+kStreamMaxPending = 64
 
 _lock = threading.Lock()
 _events_buf: List[dict] = []
 _dropped = 0
 _path_override: Optional[str] = None
+_stream_override: Optional[str] = None
+_stream_disabled = False  # configure_stream(None) = explicitly OFF
+_spool: Optional["_Spool"] = None
 _span_seq = itertools.count(1)
 _tls = threading.local()
 
@@ -83,20 +109,38 @@ def _perf_to_us(t_perf: float) -> float:
     return (_t0_wall + (t_perf - _t0_perf)) * 1e6
 
 
-# The env sink is resolved ONCE at import (unlike the event log's
+# The env sinks are resolved ONCE at import (unlike the event log's
 # per-emit read): active() sits on every stage-scope entry, and the
 # telemetry-off fast path must stay a couple of attribute reads, not an
 # os.environ lookup per scope. Late re-pointing goes through
-# configure().
+# configure() / configure_stream().
 _env_path = os.environ.get(_ENV_VAR) or None
+_env_stream = os.environ.get(_ENV_STREAM) or None
 
 
 def sink_path() -> Optional[str]:
     return _path_override or _env_path
 
 
+def stream_dir() -> Optional[str]:
+    """Segment-directory sink (streaming mode); takes precedence over
+    the single-file sink when both are configured. None after an
+    explicit ``configure_stream(None)`` even when the env var is set —
+    detaching must not silently re-open (and re-write) the env
+    directory."""
+    if _stream_disabled:
+        return None
+    return _stream_override or _env_stream
+
+
+def _streaming_configured() -> bool:
+    return not _stream_disabled and (_stream_override is not None
+                                     or _env_stream is not None)
+
+
 def active() -> bool:
-    return _path_override is not None or _env_path is not None
+    return (_path_override is not None or _env_path is not None
+            or _streaming_configured())
 
 
 def trace_id() -> str:
@@ -169,6 +213,44 @@ def configure(path: Optional[str],
         set_process_index(process_index_override)
 
 
+def configure_stream(dirpath: Optional[str],
+                     segment_bytes: Optional[int] = None,
+                     stage_events: Optional[int] = None,
+                     max_pending: Optional[int] = None,
+                     process_index_override: Optional[int] = None) -> None:
+    """Pin the streaming segment-directory sink programmatically
+    (overrides ``LIGHTGBM_TPU_TRACE_STREAM``). ``None`` turns
+    streaming OFF outright — unlike :func:`configure` it does NOT fall
+    back to the env var: detaching must never silently re-open the
+    env directory and restart its segment sequence over the previous
+    run's files. Flushes whichever sink is currently active first, so
+    each configured directory holds one self-contained segment
+    sequence. ``segment_bytes`` / ``stage_events`` / ``max_pending``
+    override the rotation size, the hot-path staging chunk, and the
+    writer backlog cap (tests shrink all three to force rotation and
+    drops at toy scale)."""
+    global _stream_override, _stream_disabled, _spool, _trace_id
+    old = _spool
+    # whichever sink is currently active gets its staged events first
+    # (a single-file trace switching into streaming mode must not
+    # orphan its buffer)
+    flush()
+    with _lock:
+        _stream_override = dirpath
+        _stream_disabled = dirpath is None
+        _spool = None
+        if old is not None:
+            _lane_ids.clear()
+            _lane_names.clear()
+            _trace_id = None
+        if stream_dir() is not None:
+            _spool = _Spool(stream_dir(), segment_bytes=segment_bytes,
+                            stage_events=stage_events,
+                            max_pending=max_pending)
+    if process_index_override is not None:
+        set_process_index(process_index_override)
+
+
 def _lane(key, name: str) -> int:
     # under _lock: concurrent first-use from the trainer, the readiness
     # drainer, and serve workers must not hand two threads one tid
@@ -191,10 +273,194 @@ def _thread_lane() -> int:
 def _push(ev: dict) -> None:
     global _dropped
     with _lock:
+        sp = _ensure_spool_locked()
+        if sp is not None:
+            sp.push(ev)
+            return
         if len(_events_buf) >= kMaxEvents:
             _dropped += 1
+            registry.inc("trace/dropped_events")
             return
         _events_buf.append(ev)
+
+
+def _ensure_spool_locked() -> Optional["_Spool"]:
+    """The active spool, creating it lazily when streaming is enabled
+    via the env var alone (configure_stream creates it eagerly).
+    Caller holds ``_lock``."""
+    global _spool
+    if _spool is None and stream_dir() is not None:
+        _spool = _Spool(stream_dir())
+    return _spool
+
+
+class _Spool:
+    """Size-rotated streaming segment writer.
+
+    Hot path: :meth:`push` (under the module ``_lock``) appends to a
+    small staging list; every ``stage_events`` events the chunk is
+    handed to a writer thread through a BOUNDED backlog — when the
+    backlog is full (writer can't keep up / disk wedged) the chunk is
+    dropped whole and counted under ``trace/dropped_events``, so RSS
+    stays bounded no matter how long the run is.
+
+    Writer thread: serializes each event once (json line) and, when the
+    serialized size of the open segment reaches ``segment_bytes``,
+    finalizes it ATOMICALLY — the full Chrome-trace document (lane
+    metadata + events + otherData) is written to ``<name>.tmp`` and
+    ``os.replace``d to ``segment-r<rank>-<seq>.json``. Every file in
+    the directory is therefore always complete, valid JSON; readers
+    (``trace_report.py tail``) never see a partial segment.
+
+    :meth:`flush` (atexit, ``log.fatal``, configure) drains staging +
+    backlog and finalizes the partial tail segment. Never raises."""
+
+    def __init__(self, dirpath: str,
+                 segment_bytes: Optional[int] = None,
+                 stage_events: Optional[int] = None,
+                 max_pending: Optional[int] = None) -> None:
+        self.dir = dirpath
+        if segment_bytes is None:
+            try:
+                segment_bytes = int(os.environ.get(
+                    _ENV_SEGMENT_BYTES, kDefaultSegmentBytes))
+            except ValueError:
+                segment_bytes = kDefaultSegmentBytes
+        self.segment_bytes = max(int(segment_bytes), 1)
+        self.stage_events = max(int(stage_events or kStreamStageEvents), 1)
+        self.max_pending = max(int(max_pending or kStreamMaxPending), 1)
+        self._staging: List[dict] = []
+        self._pending: List[List[dict]] = []
+        self._cond = threading.Condition()
+        self._busy = False
+        self._io = threading.Lock()
+        self._lines: List[str] = []
+        self._bytes = 0
+        self._seq = 0
+        self._seq_resumed = False
+        self.events_emitted = 0
+        self.dropped = 0
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(dirpath, exist_ok=True)
+
+    # -- hot path (caller holds the module _lock) -----------------------
+    def push(self, ev: dict) -> None:
+        self._staging.append(ev)
+        self.events_emitted += 1
+        if len(self._staging) >= self.stage_events:
+            self._hand_off()
+
+    def _hand_off(self) -> None:
+        chunk, self._staging = self._staging, []
+        if not chunk:
+            return
+        with self._cond:
+            if len(self._pending) >= self.max_pending:
+                self.dropped += len(chunk)
+                registry.inc("trace/dropped_events", len(chunk))
+                return
+            self._pending.append(chunk)
+            self._ensure_thread()
+            self._cond.notify()
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="obs-trace-spool", daemon=True)
+            self._thread.start()
+
+    # -- writer ---------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending:
+                    self._cond.wait()
+                chunk = self._pending.pop(0)
+                self._busy = True
+            try:
+                self._write_chunk(chunk)
+            except Exception:
+                pass  # a full disk must not kill the writer
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+
+    def _write_chunk(self, chunk: List[dict]) -> None:
+        with self._io:
+            for ev in chunk:
+                line = json.dumps(ev)
+                self._lines.append(line)
+                self._bytes += len(line) + 1
+            if self._bytes >= self.segment_bytes:
+                self._finalize_io_locked()
+
+    def _finalize_io_locked(self) -> None:
+        """Write the open segment as one complete Chrome-trace file.
+        Caller holds ``_io``; takes the module ``_lock`` only for the
+        lane-name snapshot (never the reverse order — push under
+        ``_lock`` touches only staging/backlog)."""
+        if not self._lines:
+            return
+        pid = process_index()
+        if not self._seq_resumed:
+            # continue after any segments already in the directory for
+            # this rank (a restarted run, or a re-configured spool):
+            # on-disk segments are evidence and must never be
+            # overwritten. Deferred to first finalize — the rank may
+            # be pinned (dtrain) after the spool is constructed.
+            self._seq_resumed = True
+            prefix = "segment-r%d-" % pid
+            try:
+                for f in os.listdir(self.dir):
+                    if f.startswith(prefix) and f.endswith(".json"):
+                        try:
+                            seq = int(f[len(prefix):-len(".json")])
+                        except ValueError:
+                            continue
+                        self._seq = max(self._seq, seq + 1)
+            except OSError:
+                pass
+        with _lock:
+            lanes = dict(_lane_names)
+        meta = [json.dumps(m) for m in _metadata_events(lanes, pid)]
+        other = {"trace_id": trace_id(), "host": socket.gethostname(),
+                 "os_pid": os.getpid(), "process_index": pid,
+                 "segment_index": self._seq, "events": len(self._lines),
+                 "dropped_events": self.dropped,
+                 "producer": "lightgbm_tpu/obs/trace.py"}
+        name = "segment-r%d-%05d.json" % (pid, self._seq)
+        path = os.path.join(self.dir, name)
+        body = ('{"traceEvents":[' + ",".join(meta + self._lines)
+                + '],"displayTimeUnit":"ms","otherData":'
+                + json.dumps(other) + "}")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(body)
+        os.replace(tmp, path)
+        self._seq += 1
+        self._lines = []
+        self._bytes = 0
+        registry.inc("trace/segments_written")
+
+    # -- flush ----------------------------------------------------------
+    def flush(self, timeout: float = 10.0) -> None:
+        """Drain staging + writer backlog, then finalize the partial
+        tail segment. Never raises."""
+        try:
+            with _lock:
+                self._hand_off()
+            deadline = time.perf_counter() + timeout
+            with self._cond:
+                while self._pending or self._busy:
+                    left = deadline - time.perf_counter()
+                    if left <= 0:
+                        break
+                    self._cond.wait(timeout=min(left, 0.1))
+            with self._io:
+                self._finalize_io_locked()
+        except Exception:
+            pass
 
 
 def _base_args(span_id: int = 0, parent: int = 0) -> dict:
@@ -245,17 +511,29 @@ class _Hooks:
                "cat": "stage", "args": _base_args(span_id, parent)})
 
     @staticmethod
+    def current_span() -> int:
+        """Span id open on the calling thread (0 = none) — the token
+        the readiness drainer carries so a ``::ready`` span lands on
+        the exact span that submitted the watch, not on whichever
+        span a FIFO pairing happened to be processing."""
+        stack = getattr(_tls, "stack", None)
+        return stack[-1] if stack else 0
+
+    @staticmethod
     def ready_span(name: str, t0_perf: float, t1_perf: float,
-                   queued_s: float = 0.0) -> None:
-        """Device-readiness span from the registry's async drainer."""
+                   queued_s: float = 0.0, for_span: int = 0) -> None:
+        """Device-readiness span from the registry's async drainer.
+        One lane PER STREAM (stage name): concurrent stages resolve on
+        separate drainer threads, so their spans may overlap in time —
+        distinct lanes keep the per-lane nesting invariant intact."""
         span_id = next(_span_seq)
-        args = _base_args(span_id)
+        args = _base_args(span_id, parent=for_span)
         args["queued_ms"] = round(queued_s * 1e3, 3)
         _push({"name": name + "::ready", "ph": "X",
                "ts": _perf_to_us(t0_perf),
                "dur": max((t1_perf - t0_perf) * 1e6, 0.001),
                "pid": process_index(),
-               "tid": _lane(kReadyLane, kReadyLane),
+               "tid": _lane((kReadyLane, name), kReadyLane + ":" + name),
                "cat": "ready", "args": args})
 
 
@@ -351,6 +629,20 @@ def record_device_memory(reg=registry) -> Dict[str, float]:
     return out
 
 
+# obs.export resolved once (same rule as compile.py's _get_trace):
+# sample_iteration runs once per boosting iteration and must not pay
+# import machinery per call
+_export_mod = None
+
+
+def _get_export():
+    global _export_mod
+    if _export_mod is None:
+        from . import export
+        _export_mod = export
+    return _export_mod
+
+
 _profiler_session = None  # None = not started, True = live, False = failed
 
 
@@ -391,7 +683,10 @@ def sample_iteration(iter_idx: int, reg=registry) -> None:
     trace. Programmatic ``registry.enable()`` alone (the bench's
     aggregate timing) skips it: the live-buffer fallback walks every
     live array, which would perturb the measured loop. Cheap no-op when
-    off — safe on the hot path."""
+    off — safe on the hot path. Also the training-side tick for the
+    metrics snapshot exporter + SLO watchdogs (obs/export.py), which
+    gate themselves on their own env/config."""
+    _get_export().tick(reg)
     if not (reg.timer.sampling or reg.fence() or active()):
         return
     maybe_start_profiler_session(reg)
@@ -415,9 +710,19 @@ def _metadata_events(lanes: Dict[int, str], pid: int) -> List[dict]:
 
 
 def flush() -> None:
-    """Drain in-flight readiness watches, then (re)write the whole
-    Chrome-trace JSON to the sink. Never raises — telemetry must not
-    take the caller down."""
+    """Drain in-flight readiness watches, then write the sink: in
+    streaming mode, spool the staged events and finalize the partial
+    tail segment; in single-file mode, (re)write the whole Chrome-trace
+    JSON. Never raises — telemetry must not take the caller down."""
+    if stream_dir() is not None:
+        sp = _spool
+        try:
+            registry.drain_ready(timeout=5.0)
+        except Exception:
+            pass
+        if sp is not None:
+            sp.flush()
+        return
     path = sink_path()
     if path is None:
         return

@@ -70,18 +70,25 @@ def train(params: Dict, local_X: np.ndarray, local_y: np.ndarray,
     distributed data (config.h pre_partition)."""
     config = Config.from_params(params)
     if obs_trace.active():
-        # one trace file per rank, pid = the rank: ranks share one
-        # LIGHTGBM_TPU_TRACE value, the rank is folded into the file
-        # name, and tools/trace_report.py merge interleaves the files
-        # into per-rank Perfetto lanes. Re-point the sink BEFORE any
-        # event lands (record_backend below) — configure() flushes the
-        # current buffer to the current path, and ranks must never
-        # write the shared un-ranked file
         rank = int(jax.process_index())
-        obs_trace.configure(obs_trace.rank_path(obs_trace.sink_path(),
-                                                rank),
-                            process_index_override=rank,
-                            keep_buffer=True)
+        if obs_trace.stream_dir() is not None:
+            # streaming mode: segments already carry the rank in the
+            # file name (segment-r<rank>-<seq>.json), so every rank can
+            # share one LIGHTGBM_TPU_TRACE_STREAM directory — only the
+            # pid needs pinning before the first event lands
+            obs_trace.set_process_index(rank)
+        else:
+            # one trace file per rank, pid = the rank: ranks share one
+            # LIGHTGBM_TPU_TRACE value, the rank is folded into the
+            # file name, and tools/trace_report.py merge interleaves
+            # the files into per-rank Perfetto lanes. Re-point the sink
+            # BEFORE any event lands (record_backend below) —
+            # configure() flushes the current buffer to the current
+            # path, and ranks must never write the shared un-ranked
+            # file
+            obs_trace.configure(
+                obs_trace.rank_path(obs_trace.sink_path(), rank),
+                process_index_override=rank, keep_buffer=True)
     obs_health.record_backend_once(source="dtrain")
     local_X = np.asarray(local_X, dtype=np.float64)
     local_y = np.asarray(local_y, dtype=np.float64)

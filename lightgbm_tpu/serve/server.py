@@ -122,7 +122,9 @@ class PredictServer:
     def __init__(self, model, name: str = "default", max_batch: int = 256,
                  max_wait_ms: float = 2.0, output_kind: str = "value",
                  min_bucket: int = 16, require_backend: Optional[str] = None,
-                 autostart: bool = True):
+                 autostart: bool = True,
+                 metrics_port: Optional[int] = None,
+                 metrics_host: str = "127.0.0.1"):
         if isinstance(model, ModelRegistry):
             self.registry = model
         else:
@@ -153,6 +155,22 @@ class PredictServer:
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         self.stats = {"dispatches": 0, "requests": 0, "rows": 0}
+        self._next_watch = 0.0
+        # pull-based telemetry: metrics_port != None mounts an HTTP
+        # listener serving GET /metrics (OpenMetrics text incl. the
+        # serve/latency_ms quantiles + serve/queue_depth gauge) and
+        # /healthz (JSON snapshot + currently-breached watchdog rules).
+        # port 0 binds an ephemeral port — read it from .metrics.port /
+        # .metrics.url
+        self.metrics = None
+        self.watchdog = None
+        if metrics_port is not None:
+            from ..obs.export import MetricsHTTPServer
+            from ..obs.health import Watchdog
+            self.watchdog = Watchdog()
+            self.metrics = MetricsHTTPServer(metrics_port, metrics_host,
+                                             watchdog=self.watchdog)
+            log.info("serve: /metrics listening on %s" % self.metrics.url)
         if autostart:
             self.start()
 
@@ -167,12 +185,15 @@ class PredictServer:
 
     def stop(self) -> None:
         """Stop accepting requests; the worker drains what is already
-        queued, then exits."""
+        queued, then exits. Closes the /metrics listener last so the
+        final drained state is still scrapable during shutdown."""
         with self._cond:
             self._stop = True
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=30)
+        if self.metrics is not None:
+            self.metrics.close()
 
     # ------------------------------------------------------------------
     def submit(self, x) -> Future:
@@ -280,6 +301,11 @@ class PredictServer:
         self.stats["dispatches"] += 1
         self.stats["requests"] += len(batch)
         self.stats["rows"] += rows
+        if self.watchdog is not None and now >= self._next_watch:
+            # SLO rules over the live registry at most ~1 Hz (a full
+            # snapshot per dispatch would cost more than the dispatch)
+            self._next_watch = now + 1.0
+            self.watchdog.evaluate()
         obs_events.emit(
             "predict_batch", model=self.name,
             version=self.predictor.model_version, n_requests=len(batch),

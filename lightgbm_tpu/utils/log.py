@@ -99,4 +99,13 @@ def fatal(msg: str, *args) -> None:
         _events.flush()  # buffered sink: the crash evidence must land
     except Exception:
         pass
+    try:
+        # streaming trace spool / span buffer: finalize what has been
+        # emitted so far — the segments leading up to the crash are the
+        # evidence the spool exists for
+        from ..obs import trace as _trace
+        if _trace.active():
+            _trace.flush()
+    except Exception:
+        pass
     raise LightGBMError(msg)

@@ -475,6 +475,101 @@ class TestSuppressions:
 
 
 # ---------------------------------------------------------------------------
+# JLT007 — unused suppressions
+# ---------------------------------------------------------------------------
+
+class TestJLT007:
+    def test_unused_trailing_suppression_fires(self):
+        findings, suppressed = lint("""\
+            import jax
+
+            def f(x):
+                return x + 1  # jaxlint: disable=JLT001 -- stale note
+            """)
+        assert suppressed == 0
+        assert ("JLT007", 4) in rules_at(findings)
+
+    def test_unused_standalone_suppression_fires_at_directive(self):
+        findings, _ = lint("""\
+            import jax
+
+            def f(x):
+                # jaxlint: disable=JLT001 -- this sync was removed
+                return x + 1
+            """)
+        assert ("JLT007", 4) in rules_at(findings)
+
+    def test_used_suppression_clean(self):
+        findings, suppressed = lint("""\
+            import jax
+
+            def f(x):
+                return jax.device_get(x)  # jaxlint: disable=JLT001 -- ok
+            """)
+        assert suppressed == 1
+        assert findings == []
+
+    def test_partially_used_multi_rule_directive(self):
+        # one directive naming two rules, only one of which fires:
+        # the dead half is a finding, the live half suppresses
+        findings, suppressed = lint("""\
+            import jax
+
+            def f(x):
+                return jax.device_get(x)  # jaxlint: disable=JLT001,JLT002 -- ok
+            """)
+        assert suppressed == 1
+        assert [f.rule for f in findings] == ["JLT007"]
+        assert "JLT002" in findings[0].message
+
+    def test_jlt000_suppression_is_dead_by_construction(self):
+        findings, _ = lint("""\
+            import jax
+
+            def f(x):
+                return jax.device_get(x)  # jaxlint: disable=JLT000,JLT001 -- why
+            """)
+        assert any(f.rule == "JLT007" and "JLT000" in f.message
+                   for f in findings)
+
+    def test_unknown_rule_id_flagged_on_full_run(self):
+        findings, _ = lint("""\
+            def f(x):
+                return x  # jaxlint: disable=JLT999 -- typo
+            """)
+        assert any(f.rule == "JLT007" and "JLT999" in f.message
+                   for f in findings)
+
+    def test_select_excluded_rule_not_judged(self):
+        # under --select JLT001, a JLT003 suppression might well be
+        # load-bearing on a full run — it must not be called unused
+        findings, _ = lint("""\
+            import jax
+
+            def f(x):
+                return x + 1  # jaxlint: disable=JLT003 -- real on full run
+            """, select=["JLT001", "JLT007"])
+        assert findings == []
+
+    def test_directive_with_no_following_code_is_unused(self):
+        findings, _ = lint("""\
+            import jax
+
+            def f(x):
+                return jax.device_get(x)  # jaxlint: disable=JLT001 -- ok
+            # jaxlint: disable=JLT001 -- dangles at EOF
+            """)
+        assert ("JLT007", 5) in rules_at(findings)
+
+    def test_list_rules_includes_jlt007(self, tmp_path):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.jaxlint", "--list-rules"],
+            capture_output=True, text=True, cwd=str(REPO), timeout=60)
+        assert proc.returncode == 0
+        assert "JLT007" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
 # CLI: JSON output + exit codes (the standalone CI gate)
 # ---------------------------------------------------------------------------
 

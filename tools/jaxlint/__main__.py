@@ -39,6 +39,9 @@ def main(argv=None) -> int:
             print("%s  %-14s %s" % (rid, rule.name, rule.summary))
         print("JLT000  %-14s %s" % ("bare-disable",
                                     "suppression without a rationale"))
+        print("JLT007  %-14s %s" % ("unused-disable",
+                                    "suppression that suppresses "
+                                    "nothing"))
         return 0
 
     select = args.select.split(",") if args.select else None

@@ -184,8 +184,12 @@ class Suppressions:
                     code_lines.add(ln)
         self.by_line: Dict[int, set] = {}
         self.bare: List[Tuple[int, str]] = []
+        #: every directive as (directive_line, rules, covered_code_line)
+        #: — what the unused-suppression detector (JLT007) audits; a
+        #: standalone directive with no following code covers None.
+        self.directives: List[Tuple[int, frozenset, Optional[int]]] = []
         n_lines = source.count("\n") + 1
-        pending: List[set] = []
+        pending: List[Tuple[int, set]] = []
         for i in range(1, n_lines + 1):
             entry = comments.get(i)
             if entry is not None:
@@ -193,14 +197,18 @@ class Suppressions:
                 if not has_reason:
                     self.bare.append((i, ",".join(sorted(rules))))
                 if standalone:
-                    pending.append(rules)
+                    pending.append((i, rules))
                     continue
                 self.by_line.setdefault(i, set()).update(rules)
+                self.directives.append((i, frozenset(rules), i))
             if i in code_lines:
-                for rules in pending:
+                for dline, rules in pending:
                     self.by_line.setdefault(i, set()).update(rules)
+                    self.directives.append((dline, frozenset(rules), i))
                 pending = []
             # blank and plain-comment lines keep pending alive
+        for dline, rules in pending:  # directive with no code after it
+            self.directives.append((dline, frozenset(rules), None))
 
     def active(self, rule: str, line: int) -> bool:
         return rule in self.by_line.get(line, ())
@@ -215,7 +223,8 @@ def _rules(select: Optional[Iterable[str]] = None):
     if select is None:
         return list(RULES.values())
     wanted = {s.strip().upper() for s in select}
-    wanted.discard("JLT000")  # engine-level rule, always available
+    wanted.discard("JLT000")  # engine-level rules, always available
+    wanted.discard("JLT007")
     unknown = wanted - set(RULES)
     if unknown:
         raise SystemExit("unknown rule id(s): %s (known: %s)"
@@ -233,23 +242,65 @@ def check_source(source: str, relpath: str = "<string>",
     ``"treelearner/serial.py"`` to simulate a package location)."""
     ctx = FileContext(source, path or relpath, relpath)
     sup = Suppressions(ctx.source)
+    rules_run = _rules(select)
     raw: List[Finding] = []
-    for rule in _rules(select):
+    for rule in rules_run:
         raw.extend(rule.check(ctx))
     # identical findings dedupe (e.g. JLT002 walks loop bodies twice —
     # a reuse in a loop must not be reported twice)
     raw = list(dict.fromkeys(raw))
     findings = [f for f in raw if not sup.active(f.rule, f.line)]
     suppressed = len(raw) - len(findings)
-    if select is None or "JLT000" in {s.upper() for s in select}:
+    sel = None if select is None else {s.strip().upper() for s in select}
+    if sel is None or "JLT000" in sel:
         for line, rules in sup.bare:
             findings.append(Finding(
                 "JLT000", ctx.path, line, 0,
                 "suppression of %s has no rationale — write "
                 "'# jaxlint: disable=%s -- <why this is sound>'"
                 % (rules, rules)))
+    if sel is None or "JLT007" in sel:
+        findings.extend(_unused_suppressions(ctx, sup, raw,
+                                             {r.id for r in rules_run},
+                                             full_run=sel is None))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings, suppressed
+
+
+def _unused_suppressions(ctx: FileContext, sup: Suppressions,
+                         raw: List[Finding], ran_ids: set,
+                         full_run: bool) -> List[Finding]:
+    """JLT007 — a ``# jaxlint: disable=RULE`` that suppresses nothing.
+    A directive is unused when the rule it names actually RAN and no
+    raw finding of that rule landed on the line it covers (a rule
+    excluded by ``--select`` is never judged — it might well fire on a
+    full run). Also dead by construction: directives naming JLT000
+    (bare-disable findings bypass suppression on purpose) and — on a
+    full run — rule ids that do not exist. Stale disables are worse
+    than noise: they grant a future regression at that line a free
+    pass."""
+    from .rules import RULES
+    used = {(f.line, f.rule) for f in raw if sup.active(f.rule, f.line)}
+    out: List[Finding] = []
+    for dline, drules, covered in sup.directives:
+        for rule in sorted(drules):
+            if rule == "JLT000":
+                why = ("JLT000 (bare disable) cannot be suppressed, "
+                       "so this directive does nothing")
+            elif rule in ran_ids:
+                if covered is not None and (covered, rule) in used:
+                    continue
+                why = "it matches no %s finding" % rule
+            elif full_run and rule not in RULES:
+                why = "%s is not a known rule id" % rule
+            else:
+                continue  # rule excluded by --select: cannot judge
+            out.append(Finding(
+                "JLT007", ctx.path, dline, 0,
+                "unused suppression of %s — %s; remove the stale "
+                "directive (it would silently grant a future "
+                "regression at this line a free pass)" % (rule, why)))
+    return out
 
 
 def check_file(path: str, root: Optional[str] = None,

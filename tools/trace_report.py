@@ -1,38 +1,60 @@
 #!/usr/bin/env python
 """Chrome-trace toolbox for lightgbm_tpu span traces: validate, merge,
-summarize.
+summarize, tail.
 
 Stdlib-only on purpose — it must load in <100 ms from CI and never drag
 jax into a trace-processing subprocess.
 
+Every PATH argument may be a single Chrome-trace JSON file OR a
+streaming segment DIRECTORY produced by
+``LIGHTGBM_TPU_TRACE_STREAM=dir`` (``segment-r<rank>-<seq>.json``
+files, each a complete self-contained trace file — see
+``lightgbm_tpu/obs/trace.py``).
+
 Subcommands::
 
-    trace_report.py validate trace.json
+    trace_report.py validate trace.json|segdir/
         Schema + span-nesting check (complete events properly nested
-        per (pid, tid) lane, ids resolvable, timestamps sane).
-        Exit 0 when valid, 1 with one error per line otherwise.
+        per (pid, tid) lane, ids resolvable, timestamps sane). For a
+        segment directory: each segment validates standalone (parent
+        links may cross segments), plus combined span-id-uniqueness
+        and cross-segment nesting checks; reports total dropped
+        events. Exit 0 when valid, 1 with one error per line otherwise.
 
-    trace_report.py merge -o merged.json rank0.json rank1.json ...
-        Interleave per-rank trace files by wall clock into ONE
-        Perfetto-loadable file. Each input keeps (or, on collision, is
-        remapped to) a distinct pid, so ranks render as separate
-        process lanes. Prints the aggregate stage table of the merged
-        trace to stdout.
+    trace_report.py merge -o merged.json rank0.json rank1seg/ ...
+        Interleave per-rank inputs by wall clock into ONE
+        Perfetto-loadable file. A segment directory counts as one
+        input PER RANK found inside it (segments of one rank
+        concatenate — they never pid-collide with each other). Each
+        input keeps (or, on collision, is remapped to) a distinct pid,
+        so ranks render as separate process lanes. Prints the
+        aggregate stage table of the merged trace to stdout.
 
-    trace_report.py summary trace.json [more.json ...]
+    trace_report.py summary trace.json|segdir/ [more ...]
         Aggregate spans into the same stage table BENCH phases consume:
         {"phases": {stage: {seconds, calls, p50_ms, p99_ms}}}.
 
-The traces come from ``LIGHTGBM_TPU_TRACE=path.json`` (see
-docs/OBSERVABILITY.md); multi-process dtrain writes one file per rank
-(``path.rankN.json``).
+    trace_report.py tail segdir/ [--follow] [--interval S] [--spans]
+        Live digest of a streaming run: one line per finalized segment
+        (events, spans, wall-clock window, top stages); ``--follow``
+        keeps polling for newly finalized segments until interrupted,
+        ``--spans`` prints every span of each new segment instead of
+        the digest.
+
+The traces come from ``LIGHTGBM_TPU_TRACE=path.json`` /
+``LIGHTGBM_TPU_TRACE_STREAM=dir`` (see docs/OBSERVABILITY.md);
+multi-process dtrain writes one file per rank (``path.rankN.json``) or
+rank-tagged segments into one shared directory.
 """
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import sys
-from typing import Dict, List, Tuple
+import time
+from typing import Dict, List, Optional, Tuple
 
 # spans may be emitted from perf_counter-anchored clocks; allow this
 # much boundary slop (microseconds) before calling nesting broken
@@ -41,9 +63,16 @@ kNestEpsUs = 5.0
 kKnownPhases = {"X", "i", "C", "M", "b", "e", "n"}
 
 
-def load_trace(path: str) -> dict:
-    """Load a Chrome-trace file; normalizes the bare-array form to the
-    object form."""
+def segment_files(dirpath: str) -> List[str]:
+    """Finalized segment files of a streaming trace directory, in
+    rotation order (the seq number is zero-padded, so lexical order is
+    per-rank rotation order)."""
+    return sorted(glob.glob(os.path.join(dirpath, "segment-*.json")))
+
+
+def load_file(path: str) -> dict:
+    """Load ONE Chrome-trace file; normalizes the bare-array form to
+    the object form."""
     with open(path) as f:
         doc = json.load(f)
     if isinstance(doc, list):
@@ -53,13 +82,59 @@ def load_trace(path: str) -> dict:
     return doc
 
 
+def _concat_docs(docs: List[dict], other: dict) -> dict:
+    evs: List[dict] = []
+    seen_meta = set()
+    for doc in docs:
+        for e in doc.get("traceEvents", []):
+            if isinstance(e, dict) and e.get("ph") == "M":
+                # every segment repeats the lane metadata; keep one
+                key = (e.get("name"), e.get("pid"), e.get("tid"),
+                       json.dumps(e.get("args"), sort_keys=True))
+                if key in seen_meta:
+                    continue
+                seen_meta.add(key)
+            evs.append(e)
+    return {"traceEvents": evs, "displayTimeUnit": "ms",
+            "otherData": other}
+
+
+def load_dir(dirpath: str) -> dict:
+    """Combine a segment directory into one logical trace doc
+    (segments concatenate in rotation order; lane metadata dedupes).
+    ``otherData`` carries the per-segment records plus the MAX
+    dropped-event counter seen (the spool's counter is cumulative)."""
+    files = segment_files(dirpath)
+    if not files:
+        raise ValueError("%s: no segment-*.json files" % dirpath)
+    docs = [load_file(f) for f in files]
+    segs = [dict(d.get("otherData") or {}, source_file=f)
+            for d, f in zip(docs, files)]
+    dropped = max((int(s.get("dropped_events", 0)) for s in segs),
+                  default=0)
+    return _concat_docs(docs, {"segment_dir": dirpath, "segments": segs,
+                               "dropped_events": dropped})
+
+
+def load_trace(path: str) -> dict:
+    """Load a Chrome-trace file, or a whole segment directory as one
+    combined doc."""
+    if os.path.isdir(path):
+        return load_dir(path)
+    return load_file(path)
+
+
 def _spans(doc: dict) -> List[dict]:
     return [e for e in doc.get("traceEvents", [])
             if isinstance(e, dict) and e.get("ph") == "X"]
 
 
-def validate_trace(doc: dict) -> List[str]:
-    """Return a list of schema/nesting errors (empty = valid)."""
+def validate_trace(doc: dict, check_parents: bool = True) -> List[str]:
+    """Return a list of schema/nesting errors (empty = valid).
+    ``check_parents=False`` skips parent-link resolution — a single
+    SEGMENT of a streaming trace is standalone-valid even though its
+    spans may parent into an earlier segment (the combined-directory
+    pass re-checks links across all segments)."""
     errors: List[str] = []
     evs = doc.get("traceEvents")
     if not isinstance(evs, list):
@@ -104,16 +179,51 @@ def validate_trace(doc: dict) -> List[str]:
                 span_ids.add(key)
     if errors:
         return errors
-    # parent links resolve within the same trace_id's span set
-    for e in _spans(doc):
-        args = e.get("args") or {}
-        parent = args.get("parent_span_id")
-        if parent not in (None, 0) \
-                and (args.get("trace_id"), parent) not in span_ids:
-            errors.append("span %r (%s): parent_span_id %r unknown"
-                          % (args.get("span_id"), e.get("name"), parent))
+    if check_parents:
+        # parent links resolve within the same trace_id's span set
+        for e in _spans(doc):
+            args = e.get("args") or {}
+            parent = args.get("parent_span_id")
+            if parent not in (None, 0) \
+                    and (args.get("trace_id"), parent) not in span_ids:
+                errors.append("span %r (%s): parent_span_id %r unknown"
+                              % (args.get("span_id"), e.get("name"),
+                                 parent))
     errors.extend(_check_nesting(doc))
     return errors
+
+
+def validate_dir(dirpath: str) -> Tuple[List[str], dict]:
+    """Validate a streaming segment directory: every segment must be
+    standalone-valid (parent links excepted — they may cross
+    segments), then the combined doc re-checks span-id uniqueness and
+    nesting across segments, and parent resolution when the spool
+    dropped nothing (dropped chunks legitimately take parents with
+    them). Returns (errors, stats)."""
+    files = segment_files(dirpath)
+    if not files:
+        return (["%s: no segment-*.json files" % dirpath], {})
+    errors: List[str] = []
+    for f in files:
+        try:
+            doc = load_file(f)
+        except (OSError, ValueError) as e:
+            errors.append("%s: %s" % (os.path.basename(f), e))
+            continue
+        for err in validate_trace(doc, check_parents=False):
+            errors.append("%s: %s" % (os.path.basename(f), err))
+    if errors:
+        return errors, {}
+    combined = load_dir(dirpath)
+    dropped = int(combined["otherData"].get("dropped_events", 0))
+    errors.extend(validate_trace(combined, check_parents=dropped == 0))
+    spans = _spans(combined)
+    stats = {"segments": len(files),
+             "events": len(combined["traceEvents"]),
+             "spans": len(spans),
+             "stages": len({e["name"] for e in spans}),
+             "dropped_events": dropped}
+    return errors, stats
 
 
 def _check_nesting(doc: dict) -> List[str]:
@@ -165,14 +275,47 @@ def span_tree(doc: dict) -> Dict:
     return nodes
 
 
+def _merge_inputs(paths: List[str]) -> List[Tuple[str, dict]]:
+    """Expand CLI paths into (label, doc) merge inputs: a file is one
+    input; a segment directory becomes one input PER RANK found inside
+    it (one rank's segments concatenate — they share a pid on purpose
+    and must not be remapped apart)."""
+    inputs: List[Tuple[str, dict]] = []
+    for path in paths:
+        if not os.path.isdir(path):
+            inputs.append((path, load_file(path)))
+            continue
+        files = segment_files(path)
+        if not files:
+            raise ValueError("%s: no segment-*.json files" % path)
+        by_rank: Dict[object, List[dict]] = {}
+        order: List[object] = []
+        for f in files:
+            doc = load_file(f)
+            rank = (doc.get("otherData") or {}).get("process_index")
+            if rank is None:
+                pids = {e.get("pid") for e in doc.get("traceEvents", [])
+                        if isinstance(e, dict) and "pid" in e}
+                rank = min(pids) if pids else 0
+            if rank not in by_rank:
+                order.append(rank)
+            by_rank.setdefault(rank, []).append(doc)
+        for rank in order:
+            label = "%s[rank%s]" % (path, rank)
+            inputs.append((label, _concat_docs(
+                by_rank[rank],
+                {"segment_dir": path, "process_index": rank})))
+    return inputs
+
+
 def merge_traces(paths: List[str]) -> dict:
-    """Combine per-rank trace files: distinct process lanes (pids
-    remapped on collision), events interleaved by wall-clock ts."""
+    """Combine per-rank trace files / segment directories: distinct
+    process lanes (pids remapped on collision), events interleaved by
+    wall-clock ts."""
     merged: List[dict] = []
     other: List[dict] = []
     used_pids = set()
-    for path in paths:
-        doc = load_trace(path)
+    for path, doc in _merge_inputs(paths):
         file_pids = sorted({e.get("pid") for e in doc["traceEvents"]
                             if isinstance(e, dict) and "pid" in e},
                            key=lambda p: (p is None, p))
@@ -256,11 +399,63 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[f] + (sorted_vals[c] - sorted_vals[f]) * (k - f)
 
 
+def segment_digest(path: str, doc: dict, top: int = 3) -> str:
+    """One tail line per finalized segment: size, wall-clock window,
+    heaviest stages."""
+    spans = _spans(doc)
+    per: Dict[str, float] = {}
+    for e in spans:
+        per[e["name"]] = per.get(e["name"], 0.0) + e["dur"] / 1e6
+    heavy = ", ".join("%s %.3fs" % (n, s) for n, s in
+                      sorted(per.items(), key=lambda kv: -kv[1])[:top])
+    ts = [e.get("ts") for e in doc.get("traceEvents", [])
+          if isinstance(e, dict) and isinstance(e.get("ts"), (int, float))]
+    window = ("%.3fs" % ((max(ts) - min(ts)) / 1e6)) if ts else "0s"
+    od = doc.get("otherData") or {}
+    return ("%s: %d events, %d spans, window %s, dropped %d%s"
+            % (os.path.basename(path), len(doc.get("traceEvents", [])),
+               len(spans), window, int(od.get("dropped_events", 0)),
+               (" | " + heavy) if heavy else ""))
+
+
+def tail_dir(dirpath: str, follow: bool = False, interval: float = 2.0,
+             print_spans: bool = False, out=None) -> int:
+    """Print a digest (or every span) of each segment as it finalizes.
+    One pass by default; ``--follow`` polls until interrupted."""
+    out = out or sys.stdout
+    seen: set = set()
+    while True:
+        for f in segment_files(dirpath):
+            if f in seen:
+                continue
+            seen.add(f)
+            try:
+                doc = load_file(f)
+            except (OSError, ValueError) as e:
+                print("%s: UNREADABLE (%s)" % (os.path.basename(f), e),
+                      file=out)
+                continue
+            if print_spans:
+                for e in _spans(doc):
+                    print("%s %.3f %8.3fms %s"
+                          % (os.path.basename(f), e["ts"] / 1e6,
+                             e["dur"] / 1e3, e["name"]), file=out)
+            else:
+                print(segment_digest(f, doc), file=out)
+        out.flush()
+        if not follow:
+            return 0
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="trace_report.py",
-        description="validate / merge / summarize lightgbm_tpu "
-                    "Chrome-trace files")
+        description="validate / merge / summarize / tail lightgbm_tpu "
+                    "Chrome-trace files and segment directories")
     sub = ap.add_subparsers(dest="cmd", required=True)
     ap_v = sub.add_parser("validate", help="schema + nesting check")
     ap_v.add_argument("path")
@@ -269,9 +464,28 @@ def main(argv=None) -> int:
     ap_m.add_argument("paths", nargs="+")
     ap_s = sub.add_parser("summary", help="aggregate stage table")
     ap_s.add_argument("paths", nargs="+")
+    ap_t = sub.add_parser("tail",
+                          help="digest segments of a live streaming run")
+    ap_t.add_argument("dir")
+    ap_t.add_argument("--follow", action="store_true",
+                      help="keep polling for new segments")
+    ap_t.add_argument("--interval", type=float, default=2.0)
+    ap_t.add_argument("--spans", action="store_true",
+                      help="print every span instead of per-segment "
+                           "digests")
     args = ap.parse_args(argv)
 
     if args.cmd == "validate":
+        if os.path.isdir(args.path):
+            errors, stats = validate_dir(args.path)
+            if errors:
+                for err in errors:
+                    print("INVALID: %s" % err, file=sys.stderr)
+                return 1
+            print("OK: %(segments)d segments, %(events)d events, "
+                  "%(spans)d spans, %(stages)d stages, "
+                  "%(dropped_events)d dropped" % stats)
+            return 0
         try:
             doc = load_trace(args.path)
         except (OSError, ValueError) as e:
@@ -287,6 +501,14 @@ def main(argv=None) -> int:
               % (len(doc["traceEvents"]), len(spans),
                  len({e["name"] for e in spans})))
         return 0
+
+    if args.cmd == "tail":
+        if not os.path.isdir(args.dir):
+            print("tail: %s is not a directory" % args.dir,
+                  file=sys.stderr)
+            return 2
+        return tail_dir(args.dir, follow=args.follow,
+                        interval=args.interval, print_spans=args.spans)
 
     if args.cmd == "merge":
         merged = merge_traces(args.paths)
