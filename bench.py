@@ -398,6 +398,136 @@ def run_stream_smoke() -> dict:
     }
 
 
+def run_oocore_bench() -> dict:
+    """Out-of-core smoke (``python bench.py oocore`` or BENCH_OOCORE=1):
+    build a dataset whose binned payload EXCEEDS a configured HBM budget
+    by streaming chunks through the sharded builder (io/shards.py) —
+    the raw f64 matrix never exists in host RAM — then train end-to-end
+    with the shard-sweep learner staging one shard at a time.
+
+    First-class keys: ``oocore_rows_per_sec`` (training row throughput),
+    ``oocore_peak_host_rss_mb``, ``oocore_prefetch_stall_ms``. The
+    stage ASSERTS the O(chunk) construction-memory contract: the RSS
+    growth across construction must stay under half the raw f64 matrix
+    (``rss_ok``; a failed assertion exits nonzero).
+
+    Env knobs: BENCH_OOCORE_ROWS (default 1.2M), BENCH_OOCORE_CHUNK
+    (default 100k), BENCH_OOCORE_HBM_MB (default 8 — the pretend HBM
+    budget that sizes the shards), BENCH_OOCORE_ITERS (default 2).
+    """
+    import resource
+    import shutil
+    import tempfile
+
+    import jax
+
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.shards import ShardedBinnedDataset
+    from lightgbm_tpu.obs import health as obs_health
+    from lightgbm_tpu.obs.registry import registry as obs_registry
+
+    _enable_compile_cache()
+    platform = jax.devices()[0].platform
+    obs_registry.enable()
+    obs_health.record_backend(platform, source="bench_oocore")
+
+    rows = int(os.environ.get("BENCH_OOCORE_ROWS", 1_200_000))
+    chunk = int(os.environ.get("BENCH_OOCORE_CHUNK", 100_000))
+    hbm_mb = float(os.environ.get("BENCH_OOCORE_HBM_MB", 8))
+    iters = int(os.environ.get("BENCH_OOCORE_ITERS", 2))
+    n_feat = 28
+    # the budget bounds the staged [shard_rows, F] uint8 payload
+    shard_rows = max(int(hbm_mb * 2**20) // n_feat, 4096)
+    params = {
+        "objective": "binary", "num_leaves": 31, "max_bin": 255,
+        "verbosity": -1, "min_data_in_leaf": 100,
+        "bin_construct_sample_cnt": 50_000,
+    }
+    raw_bytes = rows * n_feat * 8
+
+    def rss_mb():
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+
+    def source():
+        # chunks regenerate from seeds — the full matrix NEVER exists
+        for i in range(0, rows, chunk):
+            m = min(chunk, rows - i)
+            X, y = make_higgs_like(m, n_feat, seed=1000 + i // chunk)
+            yield X, y.astype(np.float32)
+
+    spill_dir = os.environ.get("BENCH_OOCORE_DIR") or tempfile.mkdtemp(
+        prefix="lgbm_tpu_oocore_")
+    # warm the allocator's chunk-sized arenas before the baseline: the
+    # first chunk-sized f64 allocations grow malloc arenas once for the
+    # process lifetime, which would otherwise be billed to the
+    # construction delta; the O(chunk) contract is about SCALING, and
+    # ru_maxrss only moves monotonically
+    Xw, _ = make_higgs_like(chunk, n_feat, seed=0)
+    del Xw
+    for c in source():
+        Xw = np.asarray(c[0], dtype=np.float64)
+        del Xw, c
+        break
+    rss_before = rss_mb()
+    _stage("oocore_start", rows=rows, chunk=chunk,
+           hbm_budget_mb=hbm_mb, shard_rows=shard_rows)
+    t0 = time.time()
+    ds = ShardedBinnedDataset.from_chunk_source(
+        source, Config.from_params(dict(params)), spill_dir,
+        shard_rows=shard_rows)
+    t_build = time.time() - t0
+    rss_after_build = rss_mb()
+    build_delta_mb = rss_after_build - rss_before
+    binned_mb = rows * ds.num_features * np.dtype(ds.bins_dtype).itemsize \
+        / 2**20
+    rss_ok = build_delta_mb * 2**20 < 0.5 * raw_bytes
+    _stage("oocore_built", shards=ds.num_shards,
+           t_build=round(t_build, 1), build_rss_delta_mb=build_delta_mb,
+           binned_mb=round(binned_mb, 1), rss_ok=rss_ok)
+
+    booster = create_boosting(
+        Config.from_params(dict(params, num_iterations=iters + 1)), ds)
+    booster.train_one_iter()          # warm compile out of the measure
+    jax.block_until_ready(booster.train_score)
+    stall0 = obs_registry.count("io/prefetch_stall_ms")
+    t0 = time.time()
+    done = 0
+    for _ in range(iters):
+        booster.train_one_iter()
+        done += 1
+    jax.block_until_ready(booster.train_score)
+    t_train = time.time() - t0
+    rows_per_sec = rows * done / max(t_train, 1e-9)
+    stall_ms = obs_registry.count("io/prefetch_stall_ms") - stall0
+    _stage("oocore_trained", iters=done, t_train=round(t_train, 1),
+           rows_per_sec=round(rows_per_sec, 1), stall_ms=stall_ms)
+    if not os.environ.get("BENCH_OOCORE_DIR"):
+        shutil.rmtree(spill_dir, ignore_errors=True)
+    return {
+        "metric": "oocore_rows_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "training rows/s out-of-core on %s (%.1fM rows x %df "
+                "-> %d shards of %d rows, HBM budget %.0f MB, binned "
+                "%.0f MB; build %.0fs +%d MB RSS vs %d MB raw f64; "
+                "%d iters in %.0fs, %d ms prefetch stall)%s"
+                % (platform, rows / 1e6, n_feat, ds.num_shards,
+                   shard_rows, hbm_mb, binned_mb, t_build,
+                   build_delta_mb, raw_bytes >> 20, done, t_train,
+                   stall_ms,
+                   "" if rss_ok else " [RSS NOT O(chunk): FAILED]"),
+        "backend": platform,
+        "oocore_rows_per_sec": round(rows_per_sec, 1),
+        "oocore_peak_host_rss_mb": rss_mb(),
+        "oocore_build_rss_delta_mb": build_delta_mb,
+        "oocore_prefetch_stall_ms": stall_ms,
+        "oocore_shards": ds.num_shards,
+        "oocore_hbm_budget_mb": hbm_mb,
+        "oocore_rows": rows,
+        "rss_ok": bool(rss_ok),
+    }
+
+
 def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
     if n_rows is None:
         n_rows = int(os.environ.get("BENCH_ROWS", HIGGS_ROWS))
@@ -674,6 +804,28 @@ def main() -> None:
             sys.exit(1)
         print(json.dumps(result))
         if not (result["validate_ok"] and result["merge_ok"]):
+            sys.exit(1)
+        return
+    if (os.environ.get("BENCH_OOCORE")
+            or (len(sys.argv) > 1 and sys.argv[1] == "oocore")):
+        # out-of-core smoke: the construction-memory contract and the
+        # shard-sweep training path are host+any-device; CPU default
+        if os.environ.get("JAX_PLATFORMS") in (None, "") \
+                and not os.environ.get("PALLAS_AXON_POOL_IPS"):
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            result = run_oocore_bench()
+        except Exception as e:
+            result = {"metric": "oocore_rows_per_sec", "value": 0.0,
+                      "unit": "rows/s (FAILED: %s: %s)"
+                              % (type(e).__name__, str(e)[:300]),
+                      "oocore_peak_host_rss_mb": 0,
+                      "oocore_prefetch_stall_ms": 0,
+                      "rss_ok": False}
+            print(json.dumps(result))
+            sys.exit(1)
+        print(json.dumps(result))
+        if not result["rss_ok"]:
             sys.exit(1)
         return
     if (os.environ.get("BENCH_HIST")

@@ -288,6 +288,12 @@ class GBDT:
     def add_valid_data(self, valid_data: BinnedDataset,
                        names: Optional[List[str]] = None) -> None:
         """reference: GBDT::AddValidDataset (gbdt.cpp:182)."""
+        if not hasattr(valid_data, "bins"):
+            # ValidData keeps its binned rows + scores device-resident;
+            # a sharded (out-of-core) dataset has no resident matrix
+            log.fatal("sharded datasets cannot be validation sets; "
+                      "bin the validation rows in-memory (they are "
+                      "scored per tree, not histogrammed)")
         metrics = []
         for name in resolve_metric_names(self.config, self.config.objective):
             m = create_metric(name, self.config)
@@ -640,6 +646,11 @@ class GBDT:
         learner's buffer when its layout matches (the serial learner keeps
         [N+1, F]; feature-parallel pads features, so it gets a copy)."""
         if self._train_bins_dev is None:
+            if not hasattr(self.train_data, "bins"):
+                log.fatal("this operation re-scores training rows from "
+                          "the resident bin matrix (DART drops, "
+                          "rollback); not supported with sharded "
+                          "out-of-core datasets")
             lb = getattr(self.learner, "bins", None)
             if self.train_data.bundle is not None:
                 # bundled traversal needs the bundled [N, G] layout (the

@@ -186,39 +186,14 @@ class BinnedDataset:
                 sample_idx = np.sort(rng.choice(n, sample_cnt, replace=False))
             else:
                 sample_idx = None
-            max_bin_by_feature = config.max_bin_by_feature
-            if max_bin_by_feature:
-                # reference: src/io/dataset_loader.cpp:614-616 CHECK_EQ/CHECK_GT
-                if len(max_bin_by_feature) != num_total_features:
-                    log.fatal("Length of max_bin_by_feature (%d) != number of "
-                              "features (%d)" % (len(max_bin_by_feature),
-                                                 num_total_features))
-                if min(max_bin_by_feature) <= 1:
-                    log.fatal("Each entry of max_bin_by_feature must be > 1")
-            # forcedbins_filename (config.h:740): JSON list of
-            # {"feature": i, "bin_upper_bound": [...]} entries
-            # (reference: DatasetLoader reads it into forced_bins then
-            # BinMapper::FindBin applies FindBinWithPredefinedBin)
-            forced_bounds: dict = {}
-            if getattr(config, "forcedbins_filename", ""):
-                import json
-                try:
-                    with open(config.forcedbins_filename) as fh:
-                        for entry in json.load(fh):
-                            forced_bounds[int(entry["feature"])] = [
-                                float(v)
-                                for v in entry["bin_upper_bound"]]
-                except (OSError, ValueError, KeyError, TypeError) as e:
-                    log.warning("Cannot load forced bins from %s: %s"
-                                % (config.forcedbins_filename, e))
+            max_bin_by_feature = validate_max_bin_by_feature(
+                config, num_total_features)
+            forced_bounds = load_forced_bounds(config)
             mappers: List[BinMapper] = []
             sample_bin_cols: List[np.ndarray] = []
             sample_cnt_eff = sample_cnt if sample_idx is not None else n
             with obs.scope("io::find_bins"):
                 for f in range(num_total_features):
-                    bm = BinMapper()
-                    max_bin_f = (max_bin_by_feature[f]
-                                 if f < len(max_bin_by_feature) else config.max_bin)
                     if is_sparse:
                         # feed the binner only the sampled NON-ZERO values;
                         # total_sample_cnt accounts the zeros (the reference
@@ -240,17 +215,9 @@ class BinnedDataset:
                         col = full_col(f)
                         sample_col = (col if sample_idx is None
                                       else col[sample_idx])
-                    bm.find_bin(
-                        sample_col, total_sample_cnt=sample_cnt_eff,
-                        max_bin=max_bin_f,
-                        min_data_in_bin=config.min_data_in_bin,
-                        min_split_data=config.min_data_in_leaf,
-                        pre_filter=config.feature_pre_filter,
-                        bin_type=(BinType.CATEGORICAL if f in cat_set
-                                  else BinType.NUMERICAL),
-                        use_missing=config.use_missing,
-                        zero_as_missing=config.zero_as_missing,
-                        forced_upper_bounds=forced_bounds.get(f))
+                    bm = find_bin_for_feature(
+                        f, sample_col, sample_cnt_eff, config, cat_set,
+                        forced_bounds, max_bin_by_feature)
                     mappers.append(bm)
                     if not bm.is_trivial:
                         if is_sparse:
@@ -423,6 +390,69 @@ class BinnedDataset:
         for f, bm in zip(self.used_feature_map, self.bin_mappers):
             infos[f] = bm.feature_info()
         return infos
+
+
+def validate_max_bin_by_feature(config, num_total_features: int) -> list:
+    """``max_bin_by_feature`` checks (reference:
+    src/io/dataset_loader.cpp:614-616 CHECK_EQ/CHECK_GT); returns the
+    (possibly empty) per-feature list. Shared by ``from_matrix`` and
+    the sharded builder (io/shards.py)."""
+    max_bin_by_feature = config.max_bin_by_feature
+    if max_bin_by_feature:
+        if len(max_bin_by_feature) != num_total_features:
+            log.fatal("Length of max_bin_by_feature (%d) != number of "
+                      "features (%d)" % (len(max_bin_by_feature),
+                                         num_total_features))
+        if min(max_bin_by_feature) <= 1:
+            log.fatal("Each entry of max_bin_by_feature must be > 1")
+    return max_bin_by_feature or []
+
+
+def find_bin_for_feature(f: int, sample_col: np.ndarray,
+                         total_sample_cnt: int, config: Config,
+                         cat_set: set, forced_bounds: dict,
+                         max_bin_by_feature: list) -> BinMapper:
+    """THE per-feature ``find_bin`` knob set — one definition shared by
+    ``from_matrix`` and the sharded out-of-core builder (io/shards.py),
+    so the two construction paths cannot drift apart: identical mappers
+    over an identical sample are the sharded path's bit-parity
+    contract."""
+    bm = BinMapper()
+    max_bin_f = (max_bin_by_feature[f] if f < len(max_bin_by_feature)
+                 else config.max_bin)
+    bm.find_bin(
+        sample_col, total_sample_cnt=total_sample_cnt,
+        max_bin=max_bin_f,
+        min_data_in_bin=config.min_data_in_bin,
+        min_split_data=config.min_data_in_leaf,
+        pre_filter=config.feature_pre_filter,
+        bin_type=(BinType.CATEGORICAL if f in cat_set
+                  else BinType.NUMERICAL),
+        use_missing=config.use_missing,
+        zero_as_missing=config.zero_as_missing,
+        forced_upper_bounds=forced_bounds.get(f))
+    return bm
+
+
+def load_forced_bounds(config) -> dict:
+    """forcedbins_filename (config.h:740): JSON list of
+    {"feature": i, "bin_upper_bound": [...]} entries
+    (reference: DatasetLoader reads it into forced_bins then
+    BinMapper::FindBin applies FindBinWithPredefinedBin). Shared by the
+    in-memory construction above and the out-of-core sharded builder
+    (io/shards.py)."""
+    forced_bounds: dict = {}
+    if getattr(config, "forcedbins_filename", ""):
+        import json
+        try:
+            with open(config.forcedbins_filename) as fh:
+                for entry in json.load(fh):
+                    forced_bounds[int(entry["feature"])] = [
+                        float(v) for v in entry["bin_upper_bound"]]
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            log.warning("Cannot load forced bins from %s: %s"
+                        % (config.forcedbins_filename, e))
+    return forced_bounds
 
 
 def _resolve_categorical(categorical_feature, feature_names) -> set:

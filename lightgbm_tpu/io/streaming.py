@@ -87,7 +87,9 @@ class StreamingDataset:
                  chunk_rows: int = 1 << 16,
                  has_weight: bool = False,
                  has_init_score: bool = False,
-                 has_group: bool = False):
+                 has_group: bool = False,
+                 spill_dir: Optional[str] = None,
+                 spill_threshold_rows: Optional[int] = None):
         self.config = Config.from_params(dict(params or {}))
         self.num_features = int(num_features)
         self._X = ChunkedBuffer(num_features, chunk_rows)
@@ -97,6 +99,13 @@ class StreamingDataset:
             if has_init_score else None
         self._group: Optional[List[int]] = [] if has_group else None
         self._finished = False
+        # out-of-core spill routing (io/shards.py): with a spill_dir,
+        # finalize() bins chunk-by-chunk into memory-mapped shards —
+        # the full f64 matrix is NEVER coalesced — returning a
+        # ShardedBinnedDataset. spill_threshold_rows gates the routing
+        # on size (below it the in-memory path runs as before).
+        self.spill_dir = spill_dir
+        self.spill_threshold_rows = spill_threshold_rows
 
     @property
     def num_pushed(self) -> int:
@@ -132,17 +141,76 @@ class StreamingDataset:
                 log.fatal("group pushed but has_group=False")
             self._group.extend(int(g) for g in np.atleast_1d(group))
 
+    def _chunk_source(self):
+        """Zero-copy views over the pushed chunks as a re-iterable
+        (X, y, w) chunk source for the sharded builder — the X and
+        metadata ChunkedBuffers share ``chunk_rows``, so their chunk
+        boundaries align row-for-row."""
+        n = len(self._X)
+        has_label = bool(len(self._label))
+        has_weight = self._weight is not None and bool(len(self._weight))
+        if has_label and len(self._label) != n:
+            log.fatal("pushed %d label values for %d rows"
+                      % (len(self._label), n))
+        if has_weight and len(self._weight) != n:
+            log.fatal("pushed %d weight values for %d rows"
+                      % (len(self._weight), n))
+
+        def source():
+            chunks = self._X._chunks
+            for i, xc in enumerate(chunks):
+                hi = self._X._fill if i == len(chunks) - 1 \
+                    else self._X.chunk_rows
+                y = (self._label._chunks[i][:hi, 0]
+                     if has_label else None)
+                w = (self._weight._chunks[i][:hi, 0]
+                     if has_weight else None)
+                yield xc[:hi], y, w
+        return source, n
+
     def finalize(self, reference: Optional[BinnedDataset] = None,
-                 **kw) -> BinnedDataset:
-        """Coalesce chunks, build bin mappers, bin, move to device
-        (reference: LGBM_DatasetMarkFinished → FinishLoad)."""
+                 spill_dir: Optional[str] = None,
+                 shard_rows: Optional[int] = None, **kw):
+        """Build bin mappers and bin the pushed rows (reference:
+        LGBM_DatasetMarkFinished → FinishLoad). Default: coalesce +
+        ``BinnedDataset.from_matrix`` (device-resident). With a
+        ``spill_dir`` (here or at construction) — optionally gated on
+        ``spill_threshold_rows`` — the rows route through the sharded
+        out-of-core builder instead: binned chunk-by-chunk into
+        memory-mapped shards, no f64 coalesce, identical mappers (the
+        known row count lets the sharded builder replicate
+        ``from_matrix``'s exact bin-construction sample), returning a
+        :class:`~.shards.ShardedBinnedDataset`."""
         if self._finished:
             log.fatal("finalize() called twice")
         self._finished = True
-        X = self._X.coalesce()
-        n = X.shape[0]
+        n = len(self._X)
         if n == 0:
             log.fatal("no rows pushed before finalize()")
+        spill_dir = spill_dir if spill_dir is not None else self.spill_dir
+        thr = self.spill_threshold_rows
+        if spill_dir is not None and (thr is None or n >= thr):
+            if reference is not None:
+                log.fatal("sharded finalize cannot align to a "
+                          "reference dataset")
+            if self._init_score is not None and len(self._init_score):
+                log.fatal("init_score is not supported on the sharded "
+                          "spill path")
+            if self._group:
+                log.fatal("query groups are not supported on the "
+                          "sharded spill path")
+            if kw.get("keep_raw_data"):
+                log.fatal("keep_raw_data/linear_tree needs the "
+                          "coalesced matrix; not supported on the "
+                          "sharded spill path")
+            from .shards import ShardedBinnedDataset
+            source, total = self._chunk_source()
+            return ShardedBinnedDataset.from_chunk_source(
+                source, self.config, spill_dir, shard_rows=shard_rows,
+                feature_names=kw.get("feature_names"),
+                categorical_feature=kw.get("categorical_feature"),
+                total_rows=total)
+        X = self._X.coalesce()
         def aligned(buf, what):
             if buf is None or not len(buf):
                 return None

@@ -22,6 +22,7 @@ drop counters (spool + readiness drainer).
 from __future__ import annotations
 
 import os
+import time
 from typing import Callable, Dict, List, Optional
 
 from ..utils import log
@@ -124,10 +125,19 @@ def default_rules() -> List[WatchRule]:
       (steady state should re-trace ~never);
     - ``LIGHTGBM_TPU_WATCH_QUEUE_DEPTH`` (default 1024): serve queue
       depth at or above this = admission saturation;
+    - ``LIGHTGBM_TPU_WATCH_PREFETCH_STALL`` (default 0.25): share of
+      the snapshot window the out-of-core shard prefetcher spent
+      stalling the consumer (``io/prefetch_stall_ms`` delta over wall
+      time between snapshots) at or above this = a starving loader —
+      on a day-long out-of-core run the device is idle that fraction
+      of the time waiting for shard bytes;
     - backend fallback and trace drops fire on ANY new occurrence.
     """
     retrace_thr = _env_float("LIGHTGBM_TPU_WATCH_RETRACE_SPIKE", 8)
     queue_thr = _env_float("LIGHTGBM_TPU_WATCH_QUEUE_DEPTH", 1024)
+    stall_thr = _env_float("LIGHTGBM_TPU_WATCH_PREFETCH_STALL", 0.25)
+    # below this much new stall time the share is noise, not starvation
+    kMinStallMs = 50.0
 
     def retrace_spike(snap, state):
         delta = _counter_delta(snap, state, "jit_trace/", "prev",
@@ -168,10 +178,35 @@ def default_rules() -> List[WatchRule]:
                               "full or span buffer overflow)"}
         return None
 
+    def prefetch_stall(snap, state):
+        # share of the window the shard consumer sat blocked on
+        # staging (io/shards.py ShardPrefetcher counts blocked ms);
+        # the first observation arms the baseline — construction-time
+        # staging before the first snapshot is not a breach
+        now = time.monotonic()
+        delta_ms = _counter_delta(
+            snap, state, frozenset(("io/prefetch_stall_ms",)), "prev",
+            first_is_baseline=True)
+        prev_t = state.get("prev_t")
+        state["prev_t"] = now
+        if prev_t is None or delta_ms < kMinStallMs:
+            return None
+        window = max(now - prev_t, 1e-9)
+        share = (delta_ms / 1000.0) / window
+        if share >= stall_thr:
+            return {"value": round(min(share, 1.0), 4),
+                    "threshold": stall_thr,
+                    "detail": "shard prefetcher stalled the consumer "
+                              "%.0f ms over a %.1f s window "
+                              "(loader starving the device)"
+                              % (delta_ms, window)}
+        return None
+
     return [WatchRule("retrace_spike", retrace_spike),
             WatchRule("backend_fallback", backend_fallback),
             WatchRule("queue_saturation", queue_saturation),
-            WatchRule("trace_drops", trace_drops)]
+            WatchRule("trace_drops", trace_drops),
+            WatchRule("prefetch_stall", prefetch_stall)]
 
 
 class Watchdog:

@@ -14,6 +14,18 @@ from .serial import SerialTreeLearner
 
 def create_tree_learner(config, dataset, mesh=None):
     name = getattr(config, "tree_learner", "serial")
+    from ..io.shards import ShardedBinnedDataset
+    if isinstance(dataset, ShardedBinnedDataset):
+        # out-of-core datasets have exactly one engine: the shard-sweep
+        # learner (treelearner/sharded.py). Its trees are pinned
+        # bit-identical to serial, so the promotion is silent for the
+        # default and a Warning for an explicit mesh-learner ask.
+        if name not in ("serial",):
+            log.warning("tree_learner=%s requested but the dataset is "
+                        "sharded (out-of-core); using the sharded "
+                        "shard-sweep learner" % name)
+        from .sharded import ShardedTreeLearner
+        return ShardedTreeLearner(config, dataset)
     if name in ("serial",):
         # On an accelerator the serial learner's per-split host
         # round-trips dominate (a remote chip charges ~27 ms each; 254

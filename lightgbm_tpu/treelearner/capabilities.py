@@ -86,6 +86,25 @@ class CapabilityMixin:
         return (self._constraint_groups is not None
                 or 0.0 < float(self.config.feature_fraction_bynode) < 1.0)
 
+    def _sample_features(self) -> jnp.ndarray:
+        """Per-tree column sampling (reference: ColSampler,
+        src/treelearner/col_sampler.hpp:20). Shared by the serial and
+        sharded learners — the host RNG sequence is part of the
+        bit-parity contract between them."""
+        ff = float(self.config.feature_fraction)
+        mask = np.zeros(self.Fp, dtype=bool)
+        mask[:self.F] = True
+        if 0.0 < ff < 1.0:
+            k = max(1, int(round(self.F * ff)))
+            mask[:] = False
+            mask[self._ff_rng.choice(self.F, k, replace=False)] = True
+        if self._constraint_groups is not None:
+            allowed = np.zeros(self.Fp, dtype=bool)
+            for grp in self._constraint_groups:
+                allowed[list(grp)] = True
+            mask &= allowed
+        return jnp.asarray(mask)
+
     # ------------------------------------------------------------------
     def _init_quantization(self, qbits: int, config, max_rows: int
                            ) -> None:

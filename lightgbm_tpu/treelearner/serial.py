@@ -918,23 +918,8 @@ class SerialTreeLearner(CapabilityMixin):
         self._init_cegb(config)
         self._init_monotone(config)
 
-    # ------------------------------------------------------------------
-    def _sample_features(self) -> jnp.ndarray:
-        """Per-tree column sampling (reference: ColSampler,
-        src/treelearner/col_sampler.hpp:20)."""
-        ff = float(self.config.feature_fraction)
-        mask = np.zeros(self.Fp, dtype=bool)
-        mask[:self.F] = True
-        if 0.0 < ff < 1.0:
-            k = max(1, int(round(self.F * ff)))
-            mask[:] = False
-            mask[self._ff_rng.choice(self.F, k, replace=False)] = True
-        if self._constraint_groups is not None:
-            allowed = np.zeros(self.Fp, dtype=bool)
-            for grp in self._constraint_groups:
-                allowed[list(grp)] = True
-            mask &= allowed
-        return jnp.asarray(mask)
+    # _sample_features lives on CapabilityMixin (shared with the
+    # sharded out-of-core learner, treelearner/sharded.py)
 
     # ------------------------------------------------------------------
     def _build_bundle_tables(self, dataset: BinnedDataset) -> None:

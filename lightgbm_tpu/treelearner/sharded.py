@@ -1,0 +1,405 @@
+"""Out-of-core sharded tree learner.
+
+Grows exactly the serial learner's trees over a
+:class:`~..io.shards.ShardedBinnedDataset` whose binned rows never sit
+in device memory all at once: every histogram pass is an ordered sweep
+over memory-mapped shards, each staged into HBM by the double-buffered
+:class:`~..io.shards.ShardPrefetcher` while the previous shard
+computes.
+
+Bit-parity contract (pinned by tests/test_shards.py): trees are
+BIT-IDENTICAL to :class:`~.serial.SerialTreeLearner` on the same rows
+because
+
+- gh staging, feature sampling, split scans (``find_best_split``),
+  candidate bookkeeping (``_finish_split``/``_store_info``) and the
+  split-record replay are the serial learner's own functions, reused;
+- per-leaf histograms accumulate shard-by-shard through an ORDERED
+  scatter-add (``acc.at[flat].add``) whose update order is the global
+  ascending row order — on scatter backends (CPU auto-selects the
+  segment-sum scatter path) this is the very same sequence of f32 adds
+  the serial learner's single-pass ``segment_sum`` performs, and under
+  quantized integer gradients the accumulation is exact int32/int64
+  arithmetic, order-invariant on every backend;
+- the per-tree quantization scale is ``max|g|`` over the full
+  device-resident gradient vector — identical to the serial staging —
+  so quantized rows are drawn bit-identically.
+
+Per-row O(1)-width state (the [R, 4] gh rows, per-shard row→leaf
+segments) stays device-resident: O(N) words next to the O(N·F)-byte
+bins payload the shards stream. The device argmax that picks the next
+leaf is read back once per split (the documented JLT001 sync, like the
+serial learner's per-batch read-back) — so a tree costs
+``num_leaves`` shard sweeps. Batching K splits per sweep is the
+standing follow-up (ROADMAP).
+
+Unsupported here (loud ``log.fatal`` at setup): CEGB, the
+intermediate/advanced monotone methods (``basic`` works — it lives
+inside the split scan), forced splits, interaction constraints /
+per-node column sampling, linear trees, EFB (the sharded dataset never
+bundles).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.shards import ShardedBinnedDataset, ShardPrefetcher
+from ..models.tree import Tree
+from ..obs import compile as obs_compile
+from ..obs.registry import registry as obs
+from ..ops.histogram import resolve_hist_impl, subtract_histogram
+from ..ops.quantize import acc_dtype, dequantize_sums, sum_gh
+from ..ops.split import (FeatureMeta, SplitParams, calculate_leaf_output,
+                         find_best_split, pad_feature_meta)
+from ..utils import log, next_pow2 as _next_pow2
+from ..utils.scalars import dev_bool, dev_i32
+from .capabilities import CapabilityMixin
+from .serial import (_finish_split, _go_left_by_bin, _maybe_rand_bins,
+                     _pad_rows_fn_cached, _record_at, _stage_gh_fn_cached,
+                     apply_split_record, make_root_state, record_is_valid)
+
+
+def _accum_hist(hist: jnp.ndarray, bins: jnp.ndarray,
+                gh: jnp.ndarray) -> jnp.ndarray:
+    """Ordered scatter-add of one shard's rows into the running
+    [F, B, C] accumulator. The flat-index + broadcast layout matches
+    ops/histogram._segment_histogram exactly, and seeding the scatter
+    with the RUNNING accumulator (instead of summing per-shard partials)
+    is what keeps the f32 result bit-identical to the serial learner's
+    single segment-sum pass: the adds land in the same global row
+    order. Rows with gh == 0 (shard pad, rows outside the leaf) vanish
+    from every sum."""
+    S, F = bins.shape
+    B = hist.shape[1]
+    C = gh.shape[1]
+    flat = (jnp.arange(F, dtype=jnp.int32)[None, :] * B
+            + bins.astype(jnp.int32)).reshape(-1)
+    vals = jnp.broadcast_to(
+        gh.astype(hist.dtype)[:, None, :], (S, F, C)).reshape(-1, C)
+    return hist.reshape(F * B, C).at[flat].add(vals).reshape(F, B, C)
+
+
+@functools.lru_cache(maxsize=None)
+def _zero_hist_fn_cached(Fp: int, B: int, dtype_name: str):
+    """Fresh [Fp, B, 4] accumulator per sweep, produced on device by a
+    jitted constant (an eager ``jnp.zeros`` would be an implicit
+    host→device transfer per tree — the sanitizer pins this)."""
+    dtype = jnp.dtype(dtype_name)
+
+    def zero():
+        return jnp.zeros((Fp, B, 4), dtype=dtype)
+
+    return obs_compile.instrument_jit("sharded.zero_hist", zero)
+
+
+_sum_gh_fn = obs_compile.instrument_jit("sharded.sum_gh", sum_gh)
+
+
+@functools.lru_cache(maxsize=None)
+def _gh_seg_fn_cached(n_k: int, n_pad: int):
+    """Slice one shard's [n_pad, 4] gh segment (trailing zero pad rows)
+    out of the full padded gh matrix; the pad row is the shard gather's
+    fill target."""
+    def seg(gh_full, offset):
+        part = jax.lax.dynamic_slice(
+            gh_full, (offset, jnp.int32(0)), (n_k, gh_full.shape[1]))
+        return jnp.concatenate(
+            [part, jnp.zeros((n_pad - n_k, gh_full.shape[1]),
+                             dtype=part.dtype)], axis=0)
+
+    return obs_compile.instrument_jit("sharded.gh_seg", seg)
+
+
+@functools.lru_cache(maxsize=None)
+def _root_fn_cached(L: int, B: int, extra_trees: bool, has_cat: bool):
+    """Root split scan over the swept histogram — the tail of the
+    serial learner's ``_root_fn`` with the histogram (and the channel
+    sums) computed outside."""
+    def root(hist, sums_raw, gh0, leaf0, feature_mask, children_allowed,
+             rand_seed, qscale, meta, params):
+        F = meta.num_bin.shape[0]
+        sums = dequantize_sums(sums_raw, qscale)
+        parent_out = calculate_leaf_output(sums[0], sums[1], params)
+        info = find_best_split(
+            hist, sums[0], sums[1], sums[2], sums[3], meta, params,
+            feature_mask, parent_output=parent_out,
+            rand_bins=_maybe_rand_bins(extra_trees, rand_seed, 0, meta,
+                                       params),
+            leaf_depth=jnp.int32(0), has_categorical=has_cat,
+            hist_scale=qscale)
+        state = make_root_state(gh0, hist, leaf0, info, L, F, B,
+                                children_allowed)
+        return state, _record_at(state, 0)
+
+    return obs_compile.instrument_jit("sharded.root", root)
+
+
+def _shard_step(shard_bins, leaf_seg, gh_seg, hist, rec, new_leaf, meta,
+                S: int):
+    """One shard's slice of a split step: route the shard's rows of the
+    split leaf left/right (the serial ``_split_body`` partition update,
+    applied to this contiguous row segment), then gather the rows now
+    sitting on the SMALLER child and scatter them into the running
+    child histogram. Shard segments are disjoint contiguous row ranges,
+    so sweeping them in order performs the identical per-row updates —
+    and the identical ordered histogram adds — as the serial learner's
+    full-array pass.
+
+    ``S`` is the STATIC gather width: a power-of-two bucket of the
+    smaller child's global row count (an upper bound on any shard's
+    share of it), the same trick the serial learner's ``_bucket`` uses
+    to keep deep-tree steps from scanning all rows. Fill rows hit the
+    shard's zero pad row (gh 0), so the bucket size changes compiled
+    variants, never values."""
+    n_pad = shard_bins.shape[0]
+    leaf = rec.leaf
+    f = jnp.maximum(rec.feature, 0)
+    col = jnp.take(shard_bins, f, axis=1).astype(jnp.int32)
+    gl = _go_left_by_bin(col, rec.threshold_bin, rec.default_left,
+                         meta.missing_type[f], meta.num_bin[f] - 1,
+                         meta.zero_bin[f], rec.is_categorical,
+                         rec.cat_mask)
+    on_leaf = leaf_seg == leaf
+    leaf_seg = jnp.where(on_leaf & ~gl, new_leaf, leaf_seg)
+    smaller_is_left = rec.left_total_count <= rec.right_total_count
+    small_id = jnp.where(smaller_is_left, leaf, new_leaf)
+    (idx,) = jnp.nonzero(leaf_seg == small_id, size=S,
+                         fill_value=n_pad - 1)
+    hist = _accum_hist(hist, shard_bins[idx], gh_seg[idx])
+    return leaf_seg, hist
+
+
+_shard_step_fn = obs_compile.instrument_jit(
+    "sharded.shard_step", _shard_step, static_argnums=(7,))
+
+# gather-bucket floor: caps compiled shard-step variants (serial's
+# _MIN_BUCKET discipline)
+_MIN_BUCKET = 256
+
+
+@functools.lru_cache(maxsize=None)
+def _finish_fn_cached(B: int, max_depth: int, extra_trees: bool,
+                      has_cat: bool):
+    """Split-step tail after the shard sweep: sibling subtraction from
+    the parent's stored histogram, per-leaf store updates and both
+    children's best-split scans (``_finish_split``, shared verbatim
+    with the serial learner), then the device argmax that names the
+    next split."""
+    def finish(state, rec, new_leaf, hist_small, feature_mask,
+               rand_seed, qscale, meta, params):
+        leaf = rec.leaf
+        smaller_is_left = rec.left_total_count <= rec.right_total_count
+        hist_large = subtract_histogram(state.hists[leaf], hist_small)
+        hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
+        hist_right = jnp.where(smaller_is_left, hist_large, hist_small)
+        hists = state.hists.at[leaf].set(hist_left) \
+            .at[new_leaf].set(hist_right)
+        state = state._replace(hists=hists)
+        state = _finish_split(state, rec, leaf, new_leaf,
+                              jnp.asarray(True), hist_left, hist_right,
+                              feature_mask, feature_mask, meta, params,
+                              max_depth=max_depth,
+                              extra_trees=extra_trees, has_cat=has_cat,
+                              rand_seed=rand_seed, qscale=qscale)
+        best = jnp.argmax(state.gain).astype(jnp.int32)
+        return state, _record_at(state, best)
+
+    return obs_compile.instrument_jit("sharded.finish", finish,
+                                      donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _rows_out_fn_cached(sizes: tuple):
+    """Per-shard leaf segments → the full [N] row→leaf vector the
+    boosting layer's score update gathers over."""
+    def rows_out(*segs):
+        return jnp.concatenate([s[:n] for s, n in zip(segs, sizes)])
+
+    return obs_compile.instrument_jit("sharded.rows_out", rows_out)
+
+
+class ShardedTreeLearner(CapabilityMixin):
+    """Leaf-wise grower over memory-mapped binned shards."""
+
+    def __init__(self, config, dataset: ShardedBinnedDataset):
+        self.config = config
+        self.dataset = dataset
+        N = dataset.num_data
+        F = dataset.num_features
+        if F == 0:
+            log.fatal("Cannot train without features")
+        self.N, self.F = N, F
+        # identical canonical geometry to the serial learner — part of
+        # the bit-parity contract (gh padding enters the channel sums)
+        self.B = _next_pow2(max(int(dataset.max_num_bin), 2))
+        self.L = int(config.num_leaves)
+        self.max_depth = int(config.max_depth)
+        self.R = -(-(N + 1) // 4096) * 4096
+        self.Fp = -(-F // 8) * 8
+        self._check_unsupported(config)
+        qbits = (int(getattr(config, "quant_grad_bits", 8))
+                 if getattr(config, "use_quantized_grad", False) else 0)
+        hist_impl = resolve_hist_impl(
+            getattr(config, "hist_backend", "auto"),
+            bool(getattr(config, "tpu_use_f64_hist", False)), qbits)
+        if hist_impl[1]:
+            log.warning("tpu_use_f64_hist is ignored on the sharded "
+                        "path (f32 ordered-scatter accumulation)")
+        self._init_quantization(hist_impl[2], config, N)
+        if not self._quantized and jax.default_backend() != "cpu":
+            log.warning("sharded exact-f32 training off a scatter "
+                        "backend: histogram accumulation order may "
+                        "differ from the in-memory learner "
+                        "(use_quantized_grad is order-invariant "
+                        "everywhere)")
+        self.meta = pad_feature_meta(
+            FeatureMeta.from_dataset(dataset,
+                                     int(config.max_cat_to_onehot)),
+            self.Fp - F)
+        self.params = SplitParams.from_config(config)
+        self._ff_rng = np.random.RandomState(config.feature_fraction_seed)
+        self._resolve_constraints()
+        self._extra_trees = bool(config.extra_trees)
+        self._extra_seed = int(config.extra_seed)
+        self._tree_idx = 0
+        self._has_cat = bool(np.asarray(self.meta.is_categorical).any())
+        self._hist_dtype = (np.dtype(acc_dtype(self._qdtype)).name
+                            if self._quantized else "float32")
+        self._ones_ind = jnp.ones(N, dtype=jnp.float32)
+        # per-shard geometry + the device-resident per-shard row→leaf
+        # segments' initial value (pad row = -1, never a real leaf)
+        self.prefetcher = ShardPrefetcher(dataset, self.Fp)
+        self._offsets = [int(o) for o in dataset.shard_offsets]
+        self._sizes = [int(s) for s in dataset.shard_sizes]
+        self._pads = [n + 1 for n in self._sizes]
+        self._leaf_seg0 = [
+            jnp.concatenate([jnp.zeros(n, dtype=jnp.int32),
+                             jnp.full((p - n,), -1, dtype=jnp.int32)])
+            for n, p in zip(self._sizes, self._pads)]
+        self._gh0 = jnp.zeros((1, 4), dtype=jnp.float32)
+        self._leaf0 = jnp.zeros(1, dtype=jnp.int32)
+        self._root_fn = _root_fn_cached(self.L, self.B,
+                                        self._extra_trees, self._has_cat)
+        self._finish_fn = _finish_fn_cached(self.B, self.max_depth,
+                                            self._extra_trees,
+                                            self._has_cat)
+
+    # ------------------------------------------------------------------
+    def _check_unsupported(self, config) -> None:
+        if self.dataset.bundle is not None:
+            log.fatal("sharded datasets never carry EFB bundles")
+        if config.linear_tree:
+            log.fatal("linear_tree needs raw rows resident; not "
+                      "supported with sharded datasets")
+        if config.forcedsplits_filename:
+            log.fatal("forced splits are not supported with sharded "
+                      "datasets")
+        if (config.cegb_tradeoff < 1.0 or config.cegb_penalty_split > 0.0
+                or config.cegb_penalty_feature_coupled
+                or config.cegb_penalty_feature_lazy):
+            log.fatal("CEGB is not supported with sharded datasets")
+        if config.interaction_constraints \
+                or 0.0 < float(config.feature_fraction_bynode) < 1.0:
+            log.fatal("per-node feature masks (interaction_constraints "
+                      "/ feature_fraction_bynode) are not supported "
+                      "with sharded datasets")
+        if config.monotone_constraints and any(
+                int(v) != 0 for v in config.monotone_constraints) \
+                and config.monotone_constraints_method != "basic":
+            log.fatal("monotone_constraints_method=%s needs resident "
+                      "histogrammed rescans; only 'basic' is supported "
+                      "with sharded datasets"
+                      % config.monotone_constraints_method)
+
+    def _splittable(self, depth: int) -> bool:
+        return self.max_depth <= 0 or depth < self.max_depth
+
+    def _zero_hist(self):
+        return _zero_hist_fn_cached(self.Fp, self.B, self._hist_dtype)()
+
+    # ------------------------------------------------------------------
+    def train(self, grad, hess, bag=None):
+        """Grow one tree over the shard sweep; returns the host Tree and
+        the device [N] row→leaf vector for the score update — the same
+        contract as SerialTreeLearner.train."""
+        with obs.scope("tree::stage_gh"):
+            ind = self._ones_ind if bag is None else bag
+            if self._quantized:
+                gh, self._qscale = self._quantize_stage(
+                    grad, hess, ind, self._tree_idx + 1)
+                gh = _pad_rows_fn_cached(self.R)(gh)
+            else:
+                self._qscale = self._qs_ones
+                gh = _stage_gh_fn_cached(self.R)(grad, hess, ind)
+            obs.watch_ready("tree::stage_gh", gh)
+            feature_mask = self._sample_features()
+        tree = Tree(self.L)
+        self._tree_idx += 1
+        rand_seed = dev_i32(
+            (self._extra_seed + 7919 * self._tree_idx) & 0x7FFFFFFF)
+        gh_segs = [
+            _gh_seg_fn_cached(n, p)(gh, dev_i32(o))
+            for n, p, o in zip(self._sizes, self._pads, self._offsets)]
+        leaf_segs = list(self._leaf_seg0)
+
+        with obs.scope("tree::root_histogram"):
+            hist = self._zero_hist()
+            for k, bins_dev in self.prefetcher.sweep():
+                hist = _accum_hist_fn(hist, bins_dev, gh_segs[k])
+            sums_raw = _sum_gh_fn(gh)
+            state, rec = self._root_fn(
+                hist, sums_raw, self._gh0, self._leaf0, feature_mask,
+                dev_bool(self._splittable(0)), rand_seed, self._qscale,
+                self.meta, self.params)
+            # prestart the first split's sweep: shard 0 stages through
+            # the root read-back window instead of after it
+            pending = self.prefetcher.sweep() if self.L > 1 else None
+            # jaxlint: disable=JLT001 -- the root split record must
+            # reach the host Tree (one deliberate sync per tree root)
+            rec_h = jax.device_get(rec)
+            obs.watch_ready("tree::root_histogram", rec)
+
+        next_leaf = 1
+        while next_leaf < self.L:
+            if not record_is_valid(rec_h):
+                break
+            small_count = min(float(rec_h.left_total_count),
+                              float(rec_h.right_total_count))
+            with obs.scope("tree::shard_sweep"):
+                hist_small = self._zero_hist()
+                new_leaf = dev_i32(next_leaf)
+                for k, bins_dev in pending:
+                    S = min(max(_next_pow2(int(small_count) + 16),
+                                _MIN_BUCKET), self._pads[k])
+                    leaf_segs[k], hist_small = _shard_step_fn(
+                        bins_dev, leaf_segs[k], gh_segs[k], hist_small,
+                        rec, new_leaf, self.meta, S)
+            # prestart the NEXT sweep before this split's read-back —
+            # the worker overlaps staging with the finish dispatch +
+            # sync below (one speculative staging is wasted per tree
+            # that stops early; every other split saves a stall)
+            pending = (self.prefetcher.sweep()
+                       if next_leaf + 1 < self.L else None)
+            with obs.scope("tree::split_scan"):
+                state, next_rec = self._finish_fn(
+                    state, rec, new_leaf, hist_small, feature_mask,
+                    rand_seed, self._qscale, self.meta, self.params)
+                # jaxlint: disable=JLT001 -- THE per-split host sync:
+                # the applied split's record plus the next argmax
+                # choice read back together (the sharded analogue of
+                # the serial learner's per-batch read-back)
+                next_rec_h = jax.device_get(next_rec)
+            apply_split_record(tree, self.dataset, rec_h)
+            next_leaf += 1
+            rec, rec_h = next_rec, next_rec_h
+
+        rows_out = _rows_out_fn_cached(tuple(self._sizes))
+        return tree, rows_out(*leaf_segs)
+
+
+_accum_hist_fn = obs_compile.instrument_jit("sharded.accum_hist",
+                                            _accum_hist)

@@ -679,6 +679,55 @@ class TestTransferGuardSanitizer:
             booster.train_one_iter()
         assert booster.iter == 3
 
+    @pytest.mark.parametrize("params", [
+        {"objective": "binary", "num_leaves": 7},
+        {"objective": "regression", "num_leaves": 7,
+         "use_quantized_grad": True},
+    ], ids=["sharded-exact", "sharded-quantized8"])
+    def test_sharded_iteration_stages_shards_explicitly(self, params,
+                                                        tmp_path):
+        """A warmed SHARDED training iteration under the guard: the
+        prefetcher's ``jax.device_put`` staging (io/shards.py
+        ``_device_put``) is the only sanctioned host→device transfer in
+        the shard sweep — every loop scalar rides the utils/scalars
+        cache and the per-split record read-backs are explicit
+        ``jax.device_get`` syncs. The guard is set GLOBALLY (not the
+        thread-local context manager) so it also covers the
+        prefetcher's worker thread, where the staging actually runs —
+        explicit device_put stays allowed under "disallow", implicit
+        transfers anywhere (either thread) raise."""
+        import jax
+        from lightgbm_tpu.boosting import create_boosting
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.io.shards import ShardedBinnedDataset
+        from lightgbm_tpu.obs.registry import registry
+        rng = np.random.RandomState(7)
+        X = rng.randn(600, 6)
+        y = (X[:, 0] + 0.5 * X[:, 1] - 0.2 * X[:, 2] > 0) \
+            .astype(np.float32)
+
+        def src():
+            for lo in range(0, 600, 200):
+                yield X[lo:lo + 200], y[lo:lo + 200]
+
+        cfg = Config.from_params(dict(params, num_iterations=10,
+                                      verbosity=-1))
+        ds = ShardedBinnedDataset.from_chunk_source(
+            src, cfg, str(tmp_path), shard_rows=250, total_rows=600)
+        booster = create_boosting(cfg, ds)
+        for _ in range(2):
+            booster.train_one_iter()
+        staged0 = registry.count("io/shards_staged")
+        jax.config.update("jax_transfer_guard", "disallow")
+        try:
+            booster.train_one_iter()
+        finally:
+            jax.config.update("jax_transfer_guard", "allow")
+        assert booster.iter == 3
+        # the sweep really re-staged shards inside the guarded
+        # iteration (one per shard per sweep: root + each split)
+        assert registry.count("io/shards_staged") - staged0 >= 3
+
     def test_guard_actually_guards(self):
         # meta-check: the guard in this jax version really does reject
         # implicit transfers (otherwise the tests above prove nothing)

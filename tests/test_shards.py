@@ -1,0 +1,330 @@
+"""Out-of-core sharded data plane tests (io/shards.py +
+treelearner/sharded.py): spill layout, sharded-vs-in-memory training
+parity (bit-identical trees, exact AND quantized8, across 1/3/uneven
+shard counts), prefetcher ordering + stall accounting under a fake
+slow device_put, and the StreamingDataset spill routing."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.boosting import create_boosting
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.io.shards import (ShardedBinnedDataset, ShardPrefetcher,
+                                    _SampleCollector)
+from lightgbm_tpu.io.streaming import StreamingDataset
+from lightgbm_tpu.obs.registry import registry
+
+
+def _data(n=1000, f=6, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] - X[:, 1] + 0.3 * rng.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+def _source(X, y, chunk=300, w=None):
+    def src():
+        for lo in range(0, X.shape[0], chunk):
+            if w is None:
+                yield X[lo:lo + chunk], y[lo:lo + chunk].astype(np.float32)
+            else:
+                yield (X[lo:lo + chunk],
+                       y[lo:lo + chunk].astype(np.float32),
+                       w[lo:lo + chunk].astype(np.float32))
+    return src
+
+
+BASE = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+        "bin_construct_sample_cnt": 1000, "min_data_in_leaf": 5}
+
+
+def _train(ds, params, iters=5):
+    booster = create_boosting(
+        Config.from_params(dict(params, num_iterations=iters)), ds)
+    for _ in range(iters):
+        booster.train_one_iter()
+    return booster
+
+
+class TestShardedBuilder:
+    def test_spill_layout_and_bins_match_in_memory(self, tmp_path):
+        """With the full-coverage sample, shard contents concatenate to
+        exactly the in-memory binned matrix, and the on-disk layout
+        (manifest + per-shard bins/label files) is complete."""
+        X, y = _data()
+        ds_mem = BinnedDataset.from_matrix(
+            X, Config.from_params(dict(BASE)), label=y)
+        ds = ShardedBinnedDataset.from_chunk_source(
+            _source(X, y), Config.from_params(dict(BASE)),
+            str(tmp_path), shard_rows=400, total_rows=1000)
+        assert ds.shard_sizes == [400, 400, 200]
+        assert ds.shard_offsets == [0, 400, 800]
+        assert ds.num_data == 1000
+        assert np.array_equal(ds.assemble_bins(), np.asarray(ds_mem.bins))
+        np.testing.assert_allclose(ds.metadata.label, y)
+        man = json.load(open(tmp_path / "manifest.json"))
+        assert man["num_data"] == 1000
+        assert man["shard_sizes"] == [400, 400, 200]
+        for k in range(3):
+            assert os.path.exists(ds._bins_path(k))
+            assert os.path.exists(ds._label_path(k))
+            np.testing.assert_allclose(
+                np.load(ds._label_path(k)),
+                y[ds.shard_offsets[k]:ds.shard_offsets[k]
+                  + ds.shard_sizes[k]])
+        # memmapped access, not a whole-file load
+        mm = ds.shard_bins_host(1)
+        assert isinstance(mm, np.memmap)
+        assert mm.shape == (400, ds.num_features)
+
+    def test_refuses_nonempty_spill_dir(self, tmp_path):
+        """Spilled shards are live training data (re-memmapped every
+        sweep): a second build must never clobber them."""
+        from lightgbm_tpu.utils.log import LightGBMError
+        X, y = _data(400)
+        ShardedBinnedDataset.from_chunk_source(
+            _source(X, y, chunk=200), Config.from_params(dict(BASE)),
+            str(tmp_path), shard_rows=200, total_rows=400)
+        with pytest.raises(LightGBMError, match="already holds"):
+            ShardedBinnedDataset.from_chunk_source(
+                _source(X, y, chunk=200),
+                Config.from_params(dict(BASE)), str(tmp_path),
+                shard_rows=200, total_rows=400)
+
+    def test_weights_spill_per_shard(self, tmp_path):
+        X, y = _data(500)
+        w = np.random.RandomState(0).rand(500) + 0.5
+        ds = ShardedBinnedDataset.from_chunk_source(
+            _source(X, y, chunk=200, w=w),
+            Config.from_params(dict(BASE)), str(tmp_path),
+            shard_rows=180, total_rows=500)
+        assert ds.has_weights
+        np.testing.assert_allclose(ds.metadata.weights,
+                                   w.astype(np.float32))
+        assert os.path.exists(ds._weight_path(0))
+
+    def test_reservoir_covers_all_rows_when_sample_large(self):
+        """Unknown total_rows + a covering sample cap → the sample IS
+        the full row set in row order (what makes unknown-length
+        sources mapper-identical to from_matrix)."""
+        sc = _SampleCollector(1000, 3, seed=1, total_rows=None)
+        rng = np.random.RandomState(0)
+        parts = [rng.randn(m, 3) for m in (400, 350, 250)]
+        for p in parts:
+            sc.add(p)
+        rows, cnt = sc.finish()
+        assert cnt == 1000
+        np.testing.assert_array_equal(rows, np.concatenate(parts))
+
+    def test_reservoir_bounded_when_sample_small(self):
+        sc = _SampleCollector(100, 2, seed=1, total_rows=None)
+        for _ in range(20):
+            sc.add(np.random.RandomState(0).randn(500, 2))
+        rows, cnt = sc.finish()
+        assert cnt == 100 and rows.shape == (100, 2)
+
+
+class TestShardedTrainingParity:
+    """The acceptance pin: training from a ShardedBinnedDataset
+    produces BIT-IDENTICAL trees (and training scores) to
+    BinnedDataset.from_matrix on the same rows."""
+
+    @pytest.mark.parametrize("extra", [
+        {}, {"use_quantized_grad": True},
+        {"use_quantized_grad": True, "quant_grad_bits": 16},
+        {"bagging_fraction": 0.7, "bagging_freq": 1},
+    ], ids=["exact", "quantized8", "quantized16", "bagging"])
+    @pytest.mark.parametrize("shard_rows", [1000, 334, 256],
+                             ids=["1shard", "3shards", "uneven4"])
+    def test_bit_identical_trees(self, tmp_path, shard_rows, extra):
+        X, y = _data()
+        params = dict(BASE, **extra)
+        ds_mem = BinnedDataset.from_matrix(
+            X, Config.from_params(dict(params)), label=y)
+        b_mem = _train(ds_mem, params)
+        ds_sh = ShardedBinnedDataset.from_chunk_source(
+            _source(X, y), Config.from_params(dict(params)),
+            str(tmp_path), shard_rows=shard_rows, total_rows=1000)
+        b_sh = _train(ds_sh, params)
+        assert b_sh.save_model_to_string() == b_mem.save_model_to_string()
+        # scores bit-identical too: the leaf gather runs over the same
+        # partition with the same compiled update
+        s_mem = np.asarray(b_mem.train_score, dtype=np.float32)
+        s_sh = np.asarray(b_sh.train_score, dtype=np.float32)
+        assert np.array_equal(s_sh.view(np.uint32), s_mem.view(np.uint32))
+
+    def test_multiclass_parity(self, tmp_path):
+        rng = np.random.RandomState(5)
+        X = rng.randn(900, 5)
+        y = np.digitize(X[:, 0], [-0.5, 0.5]).astype(np.float64)
+        params = dict(BASE, objective="multiclass", num_class=3,
+                      bin_construct_sample_cnt=900)
+        ds_mem = BinnedDataset.from_matrix(
+            X, Config.from_params(dict(params)), label=y)
+        b_mem = _train(ds_mem, params, iters=3)
+        ds_sh = ShardedBinnedDataset.from_chunk_source(
+            _source(X, y, chunk=250), Config.from_params(dict(params)),
+            str(tmp_path), shard_rows=400, total_rows=900)
+        b_sh = _train(ds_sh, params, iters=3)
+        assert b_sh.save_model_to_string() == b_mem.save_model_to_string()
+
+    def test_unsupported_modes_fail_loudly(self, tmp_path):
+        from lightgbm_tpu.utils.log import LightGBMError
+        X, y = _data(400)
+        ds = ShardedBinnedDataset.from_chunk_source(
+            _source(X, y, chunk=200),
+            Config.from_params(dict(BASE)), str(tmp_path),
+            shard_rows=200, total_rows=400)
+        for bad in ({"linear_tree": True},
+                    {"cegb_penalty_split": 0.1},
+                    {"interaction_constraints": [[0, 1]]},
+                    {"monotone_constraints": [1, 0, 0, 0, 0, 0],
+                     "monotone_constraints_method": "intermediate"}):
+            with pytest.raises(LightGBMError):
+                create_boosting(Config.from_params(
+                    dict(BASE, num_iterations=2, **bad)), ds)
+        # DART needs resident-row re-scoring
+        with pytest.raises(LightGBMError):
+            b = create_boosting(Config.from_params(
+                dict(BASE, boosting="dart", num_iterations=3)), ds)
+            for _ in range(3):
+                b.train_one_iter()
+        # sharded valid sets are rejected
+        b = create_boosting(
+            Config.from_params(dict(BASE, num_iterations=2)), ds)
+        with pytest.raises(LightGBMError):
+            b.add_valid_data(ds)
+
+
+class TestShardPrefetcher:
+    def _dataset(self, tmp_path, n=800, shard_rows=200):
+        X, y = _data(n)
+        return ShardedBinnedDataset.from_chunk_source(
+            _source(X, y, chunk=250), Config.from_params(dict(BASE)),
+            str(tmp_path), shard_rows=shard_rows, total_rows=n)
+
+    def test_ordering_and_stall_under_slow_device(self, tmp_path,
+                                                  monkeypatch):
+        """A slow staging device must not reorder shards, and blocked
+        consumer time must land on the io/prefetch_stall_ms counter."""
+        from lightgbm_tpu.io import shards as shards_mod
+        ds = self._dataset(tmp_path)          # 4 shards of 200
+        staged = []
+        real_put = shards_mod._device_put
+
+        def slow_put(x):
+            time.sleep(0.05)
+            staged.append(x.shape)
+            return real_put(x)
+
+        monkeypatch.setattr(shards_mod, "_device_put", slow_put)
+        registry.reset()
+        pf = ShardPrefetcher(ds, pad_cols=8)
+        for sweep in range(2):
+            seen = [k for k, arr in pf.sweep()]
+            assert seen == [0, 1, 2, 3]
+        # 4 shards x 2 sweeps staged in order (no resident cache at 4)
+        assert len(staged) == 8
+        assert registry.count("io/prefetch_stall_ms") > 0
+        assert registry.count("io/shards_staged") == 8
+        pf.close()
+
+    def test_staged_content_and_padding(self, tmp_path):
+        ds = self._dataset(tmp_path)
+        pf = ShardPrefetcher(ds, pad_cols=8)
+        for k, arr in pf.sweep():
+            host = np.asarray(arr)
+            assert host.shape == (ds.shard_sizes[k] + 1, 8)
+            np.testing.assert_array_equal(
+                host[:ds.shard_sizes[k], :ds.num_features],
+                np.asarray(ds.shard_bins_host(k)))
+            assert (host[-1] == 0).all()          # gather-fill pad row
+            assert (host[:, ds.num_features:] == 0).all()
+        pf.close()
+
+    def test_small_shard_counts_cached_resident(self, tmp_path,
+                                                monkeypatch):
+        """<=2 shards fit the double buffer anyway: staged once, served
+        from cache on later sweeps."""
+        from lightgbm_tpu.io import shards as shards_mod
+        ds = self._dataset(tmp_path, n=400, shard_rows=200)
+        calls = []
+        real_put = shards_mod._device_put
+        monkeypatch.setattr(shards_mod, "_device_put",
+                            lambda x: calls.append(1) or real_put(x))
+        pf = ShardPrefetcher(ds, pad_cols=8)
+        for _ in range(3):
+            assert [k for k, _ in pf.sweep()] == [0, 1]
+        assert len(calls) == 2
+        pf.close()
+
+
+class TestStreamingSpill:
+    """Satellite: StreamingDataset.finalize routes through the sharded
+    builder instead of coalescing the full f64 matrix."""
+
+    def _push(self, X, y, **kw):
+        sd = StreamingDataset(num_features=X.shape[1],
+                              params=dict(BASE), **kw)
+        for lo in range(0, X.shape[0], 300):
+            sd.push_rows(X[lo:lo + 300], label=y[lo:lo + 300])
+        return sd
+
+    def test_finalize_spill_dir_returns_sharded(self, tmp_path):
+        X, y = _data()
+        ds = self._push(X, y).finalize(spill_dir=str(tmp_path),
+                                       shard_rows=400)
+        assert isinstance(ds, ShardedBinnedDataset)
+        assert ds.shard_sizes == [400, 400, 200]
+        # mappers replicate from_matrix EXACTLY (known row count →
+        # identical bin-construction sample), so the binned rows match
+        ds_mem = BinnedDataset.from_matrix(
+            X, Config.from_params(dict(BASE)), label=y)
+        assert np.array_equal(ds.assemble_bins(), np.asarray(ds_mem.bins))
+        np.testing.assert_allclose(ds.metadata.label, y)
+
+    def test_spilled_mappers_exact_even_when_subsampled(self, tmp_path):
+        """The spill route replicates from_matrix's rng.choice sample
+        (sample_cnt < n), not just the full-coverage case."""
+        X, y = _data(1000)
+        params = dict(BASE, bin_construct_sample_cnt=300)
+        sd = StreamingDataset(num_features=X.shape[1], params=params)
+        for lo in range(0, 1000, 250):
+            sd.push_rows(X[lo:lo + 250], label=y[lo:lo + 250])
+        ds = sd.finalize(spill_dir=str(tmp_path), shard_rows=400)
+        ds_mem = BinnedDataset.from_matrix(
+            X, Config.from_params(dict(params)), label=y)
+        assert [m.feature_info() for m in ds.bin_mappers] == \
+            [m.feature_info() for m in ds_mem.bin_mappers]
+        assert np.array_equal(ds.assemble_bins(), np.asarray(ds_mem.bins))
+
+    def test_spill_threshold_gates_routing(self, tmp_path):
+        X, y = _data(600)
+        ds = self._push(X, y, spill_dir=str(tmp_path),
+                        spill_threshold_rows=10 ** 9).finalize()
+        assert isinstance(ds, BinnedDataset)      # below threshold
+        ds2 = self._push(X, y, spill_dir=str(tmp_path / "b"),
+                         spill_threshold_rows=100).finalize()
+        assert isinstance(ds2, ShardedBinnedDataset)
+
+    def test_spilled_training_matches_coalesced(self, tmp_path):
+        X, y = _data()
+        ds_sh = self._push(X, y).finalize(spill_dir=str(tmp_path),
+                                          shard_rows=334)
+        ds_mem = self._push(X, y).finalize()
+        b_sh = _train(ds_sh, BASE)
+        b_mem = _train(ds_mem, BASE)
+        assert b_sh.save_model_to_string() == b_mem.save_model_to_string()
+
+    def test_spill_rejects_unsupported_metadata(self, tmp_path):
+        from lightgbm_tpu.utils.log import LightGBMError
+        X, y = _data(400)
+        sd = StreamingDataset(num_features=X.shape[1],
+                              params=dict(BASE), has_group=True)
+        sd.push_rows(X, label=y, group=[100, 300])
+        with pytest.raises(LightGBMError):
+            sd.finalize(spill_dir=str(tmp_path))

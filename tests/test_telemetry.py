@@ -490,6 +490,35 @@ def test_watchdog_fires_exactly_once_per_breach():
                for r in seen)
 
 
+def test_watchdog_prefetch_stall_share():
+    """The out-of-core loader rule: fires when the shard prefetcher's
+    stall-time share of the snapshot window crosses the threshold,
+    stays quiet for sub-threshold/noise-level stalls, re-arms on
+    recovery (one health event per starvation episode on a day-long
+    run)."""
+    registry.reset()
+    seen = []
+    events.register_event_callback(
+        lambda rec: seen.append(rec) if rec["event"] == "health" else None)
+    wd = Watchdog(registry)
+    assert wd.evaluate() == []              # arms baseline + window
+    # a huge stall delta over a tiny window: share >> threshold
+    registry.inc("io/prefetch_stall_ms", 60_000)
+    assert [f["rule"] for f in wd.evaluate()] == ["prefetch_stall"]
+    assert wd.evaluate() == []              # no new stalls: re-armed
+    # noise-level stall (< kMinStallMs) never fires even though the
+    # evaluation window is microseconds
+    registry.inc("io/prefetch_stall_ms", 10)
+    assert wd.evaluate() == []
+    # a second real starvation episode fires again
+    registry.inc("io/prefetch_stall_ms", 120_000)
+    assert [f["rule"] for f in wd.evaluate()] == ["prefetch_stall"]
+    events.register_event_callback(None)
+    assert [r["rule"] for r in seen] == ["prefetch_stall"] * 2
+    assert all(0 < r["value"] <= 1.0 and "threshold" in r for r in seen)
+    assert registry.count("health/prefetch_stall") == 2
+
+
 def test_watchdog_inline_tick_env(monkeypatch):
     """LIGHTGBM_TPU_WATCHDOG=1 routes per-iteration ticks through the
     default watchdog even without a metrics file exporter."""
