@@ -244,13 +244,8 @@ class GBDT:
         self.sample_strategy.reset_metadata(train_data.metadata)
 
         K = self.num_tree_per_iteration
-        score = np.zeros((self.num_data, K), dtype=np.float32)
         self._has_init_score = train_data.metadata.init_score is not None
-        if self._has_init_score:
-            init = np.asarray(train_data.metadata.init_score,
-                              dtype=np.float64)
-            score += init.reshape(K, -1).T.astype(np.float32)
-        self.train_score = jnp.asarray(score)
+        self.train_score = jnp.asarray(self._initial_score())
 
         self.class_need_train = [True] * K
         if self.objective is not None:
@@ -590,6 +585,58 @@ class GBDT:
         else:
             self.train_score = score_t
         return stopped
+
+    # ------------------------------------------------------------------
+    def _initial_score(self) -> np.ndarray:
+        """[N, K] f32 starting scores: zeros plus the metadata
+        init_score in its class-major-to-column layout — THE layout
+        convention shared by training-score init and the
+        recheck_scores replay (one definition, so the two cannot
+        drift)."""
+        K = self.num_tree_per_iteration
+        score = np.zeros((self.num_data, K), dtype=np.float32)
+        if self._has_init_score \
+                and self.train_data.metadata.init_score is not None:
+            init = np.asarray(self.train_data.metadata.init_score,
+                              dtype=np.float64)
+            score += init.reshape(K, -1).T.astype(np.float32)
+        return score
+
+    # ------------------------------------------------------------------
+    def recheck_scores(self, reason: str = "") -> float:
+        """Batched-eval double-check (ROADMAP gap): replay every model
+        tree over the training rows on device and compare the summed
+        outputs against the incrementally maintained ``train_score``.
+        Called ONCE at the transition when a quantized batched run
+        degrades to per-iteration training — the hand-off point
+        between the fused scan's device-maintained scores and the
+        looped path — and emits one ``batched_eval_recheck`` event
+        carrying the max deviation (plus a Warning when it exceeds
+        the f32 replay tolerance). Returns the max abs deviation."""
+        if not hasattr(self.train_data, "bins"):
+            return 0.0  # sharded datasets cannot replay resident rows
+        K = self.num_tree_per_iteration
+        replay_dev = jnp.asarray(self._initial_score())
+        for idx, tree in enumerate(self.models):
+            delta = self._tree_outputs_train(tree)
+            if delta is not None:
+                replay_dev = replay_dev.at[:, idx % K].add(delta)
+        # jaxlint: disable=JLT001 -- one-shot verification sync at the
+        # batched->looped transition (the event below is the point)
+        diff = float(jnp.max(jnp.abs(replay_dev - self.train_score)))
+        # jaxlint: disable=JLT001 -- same one-shot verification sync
+        scale = max(float(jnp.max(jnp.abs(self.train_score))), 1.0)
+        ok = diff <= 1e-3 * scale
+        obs_events.emit("batched_eval_recheck", reason=reason,
+                        iter=self.iter, trees=len(self.models),
+                        max_abs_diff=round(diff, 9), ok=ok)
+        if not ok:
+            log.warning(
+                "batched-eval recheck at the batched->looped "
+                "transition found score deviation %.3g (replay of %d "
+                "trees vs the incrementally maintained device score)"
+                % (diff, len(self.models)))
+        return diff
 
     # ------------------------------------------------------------------
     def _update_score(self, tree: Tree, leaf_of_row: jnp.ndarray,

@@ -355,6 +355,19 @@ class Config:
     # per-iteration host work (boosting/gbdt.py train_batch); amortizes
     # remote-chip dispatch latency. 0/1 = per-iteration training.
     tpu_batch_iterations: int = 0
+    # fused whole-tree growth (treelearner/serial.py): histogram →
+    # split scan → partition for the entire tree runs as ONE jitted
+    # while_loop dispatch with a device-resident frontier, reading back
+    # only the finished [L-1] split-record buffer (bit-identical to the
+    # stepped host loop). False keeps the legacy per-batch host loop.
+    tpu_fused_tree: bool = True
+    # out-of-core frontier batching (treelearner/sharded.py): speculate
+    # up to K pending best-split candidates per shard sweep — each
+    # staging applies K partition updates and histograms K children —
+    # cutting shard staging traffic up to K× per tree while the
+    # device-validated finish keeps trees bit-identical to serial
+    # growth. 0/1 = legacy one-split-per-sweep.
+    tpu_frontier_splits: int = 8
     hist_backend: str = "auto"       # auto | scatter | onehot | pallas
     mesh_shape: str = ""             # e.g. "data=8" or "data=4,feature=2"
 

@@ -120,6 +120,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
                      % (batch_n, batch_n))
         i = 0
         degraded = False
+        ran_batched = False
+        rechecked = False
         while i < num_boost_round and not degraded:
             for cb in callbacks_before:
                 cb(callback_mod.CallbackEnv(
@@ -132,7 +134,19 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 # the whole T-iteration program for a one-off length
                 finished = booster.inner.train_batch(batch_n)
                 i += batch_n
+                ran_batched = True
             else:
+                if (ran_batched and not rechecked
+                        and cfg.use_quantized_grad):
+                    # batched -> per-iteration transition of a
+                    # QUANTIZED run: the scan maintained the scores on
+                    # device through redrawn stochastic roundings —
+                    # re-verify them once against a full tree replay
+                    # before the looped path builds on them (emits a
+                    # batched_eval_recheck event)
+                    booster.inner.recheck_scores(
+                        reason="batched_to_looped")
+                    rechecked = True
                 finished = booster.update(fobj=fobj)
                 i += 1
                 if not finished and not booster.inner.can_train_batched():

@@ -419,6 +419,17 @@ def subtract_histogram(parent: jnp.ndarray, child: jnp.ndarray) -> jnp.ndarray:
     return parent - child
 
 
+def mask_gh(gh: jnp.ndarray, keep) -> jnp.ndarray:
+    """Dtype-preserving row mask: zero the gh rows where ``keep`` is
+    False (``keep`` is [S] per-row or a scalar). A float multiply
+    would silently de-quantize integer gh rows; ``where`` against a
+    same-dtype zero keeps the int8/int16 stream intact."""
+    keep = jnp.asarray(keep)
+    if keep.ndim == 1:
+        keep = keep[:, None]
+    return jnp.where(keep, gh, jnp.zeros((), dtype=gh.dtype))
+
+
 def unpack_bundle_histogram(bhist: jnp.ndarray,
                             gidx_g: jnp.ndarray, gidx_b: jnp.ndarray,
                             zero_fix: jnp.ndarray, zero_bins: jnp.ndarray,

@@ -128,6 +128,22 @@ quantize_gh = obs_compile.instrument_jit(
     "ops.quantize_gh", _quantize_gh, static_argnums=(4, 5))
 
 
+def _tree_key(base_key, ctr):
+    """Advance the device-side tree counter and derive the tree's
+    stochastic-rounding key: ``fold_in(base, ctr + 1)``. The counter
+    sequence (1, 2, ...) reproduces the host tree numbering the key
+    derivation used before, bit-exactly — but the counter lives on
+    device, so the steady-state training loop performs ZERO per-tree
+    seed transfers (each new tree number used to be a fresh
+    ``dev_u32`` device_put). The batched scan threads the same
+    fold-in through its carry (parallel/data_parallel.py)."""
+    nxt = ctr + jnp.uint32(1)
+    return jax.random.fold_in(base_key, nxt), nxt
+
+
+tree_key = obs_compile.instrument_jit("ops.quantize_tree_key", _tree_key)
+
+
 def sum_gh(gh: jnp.ndarray) -> jnp.ndarray:
     """Channel sums with the overflow-safe accumulator: integer gh sums
     in acc_dtype (exact), float gh keeps its dtype (the existing f32
@@ -158,9 +174,17 @@ def dequantize_hist(hist: jnp.ndarray, qscale) -> jnp.ndarray:
     per-scan rounding — float histograms pass through untouched. The
     ones fallback for a missing scale exists only for trace-shaped
     callers in exact mode; quantized learners always pass their
-    current ``_qscale``."""
+    current ``_qscale``.
+
+    The barrier pins the dequantized values: without it XLA is free to
+    contract the scale multiply into the split scan's cumsum chains
+    (an FMA), and WHETHER it does depends on the surrounding program —
+    the same scan then returns different last-ulp gains inside the
+    frontier-batched grower than inside the one-split finish,
+    breaking the learners' bit-parity contract. Materializing the
+    product makes every compile see the same f32 inputs."""
     if not jnp.issubdtype(hist.dtype, jnp.integer):
         return hist
     sv = (scale4(qscale) if qscale is not None
           else jnp.ones(4, dtype=jnp.float32))
-    return hist.astype(jnp.float32) * sv
+    return jax.lax.optimization_barrier(hist.astype(jnp.float32) * sv)

@@ -172,6 +172,33 @@ class SplitInfo(NamedTuple):
     right_max_output: jnp.ndarray
 
 
+def select_frontier(gain: jnp.ndarray, k: int):
+    """(leaves [k] i32, sel_gain [k] f32) of the top-``k`` pending
+    split candidates, slot 0 GUARANTEED to be ``jnp.argmax(gain)``
+    (ties included). The frontier-batched growers
+    (treelearner/sharded.py) speculate these as the next ``k``
+    leaf-wise splits in order; pinning slot 0 to the argmax is what
+    guarantees every validated sweep round accepts at least one split
+    — livelock-free even where ``lax.top_k``'s tie ordering disagrees
+    with repeated argmax.
+
+    ``sel_gain`` is the SELECTION value, not a gather of ``gain``:
+    when fewer than ``k`` live candidates exist, ``top_k`` over the
+    masked vector hands back arbitrary -inf slots whose indices may
+    ALIAS a live leaf — reading that leaf's record would resurrect an
+    already-consumed candidate (a stale re-split the order validation
+    cannot distinguish from the real one). The -inf selection value is
+    what marks such a slot dead; callers must thread it into the
+    speculation record's gain."""
+    best = jnp.argmax(gain).astype(jnp.int32)
+    if k <= 1:
+        return best[None], gain[best][None]
+    masked = gain.at[best].set(-jnp.inf)
+    vals, rest = jax.lax.top_k(masked, k - 1)
+    return (jnp.concatenate([best[None], rest.astype(jnp.int32)]),
+            jnp.concatenate([gain[best][None], vals]))
+
+
 def threshold_l1(s: jnp.ndarray, l1: jnp.ndarray) -> jnp.ndarray:
     """Soft-threshold by the L1 penalty (reference:
     feature_histogram.hpp ``ThresholdL1``)."""

@@ -10,6 +10,20 @@ from .ops.split import SplitParams
 def refresh_learner_params(learner, config) -> None:
     learner.params = SplitParams.from_config(config)
     learner.max_depth = int(config.max_depth)
+    if hasattr(learner, "_fused_growth"):
+        # serial learner: the fused/stepped choice is re-readable (the
+        # stepped path is the documented bit-parity fallback)
+        learner._fused_growth = bool(
+            getattr(config, "tpu_fused_tree", True))
+    if hasattr(learner, "_K"):
+        learner._K = max(1, min(
+            int(getattr(config, "tpu_frontier_splits", 8)),
+            learner.L - 1))
+    if hasattr(learner, "_rebind_compiled"):
+        # sharded learner: max_depth and K are STATIC keys of its
+        # cached finish/kfinish/spec programs — re-resolve them (a
+        # stale binding would keep gating depth at the old max_depth)
+        learner._rebind_compiled()
     # jitted step closures bake the old params as constants — drop them
     # so the next tree re-traces with the new values
     if hasattr(learner, "_step_cache"):

@@ -54,7 +54,8 @@ from ..io.dataset import BinnedDataset
 from ..models.tree import Tree
 from ..obs import compile as obs_compile
 from ..obs.registry import registry as obs
-from ..ops.histogram import (build_histogram, subtract_histogram,
+from ..ops.histogram import (build_histogram, mask_gh,
+                             subtract_histogram,
                              unpack_bundle_histogram)
 from ..ops.quantize import dequantize_sums, sum_gh
 from ..ops.split import (FeatureMeta, SplitParams, calculate_leaf_output,
@@ -378,10 +379,8 @@ class DataParallelTreeLearner(CapabilityMixin):
         else:
             # dtype-preserving mask (an f32 multiply would de-quantize
             # integer gh rows)
-            gh_masked = jnp.where(
-                small_sel[:, None], state.gh,
-                jnp.zeros((), dtype=state.gh.dtype))
-            hist_small = self._mesh_hist(bins, gh_masked, small_totals)
+            hist_small = self._mesh_hist(
+                bins, mask_gh(state.gh, small_sel), small_totals)
         hist_large = subtract_histogram(state.hists[leaf], hist_small)
         hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
         hist_right = jnp.where(smaller_is_left, hist_large, hist_small)
@@ -801,15 +800,16 @@ class DataParallelTreeLearner(CapabilityMixin):
         """True when the split scan needs no per-split or per-tree host
         state (CEGB penalties, monotone trackers, per-node feature
         masks) and no host RNG (feature_fraction redraws a host mask
-        per tree)."""
+        per tree). Quantized-gradient mode batches too: the per-tree
+        stochastic-rounding key folds in from a scan-carried device
+        counter, and the scan's ``alive`` flag freezes the score after
+        a stump step — a later redraw can no longer grow a tree the
+        host never applies."""
         return (not self._cegb_enabled
                 and self._mono_tracker is None
                 and not self._needs_per_node_masks()
-                and not self._extra_trees  # per-seed rand_bins break the
-                # partial-batch stop argument in GBDT.train_batch
-                and not self._quantized  # same reason: a post-stump step
-                # redraws the stochastic rounding and may grow a tree the
-                # host never applies
+                and not self._extra_trees  # per-seed rand_bins would
+                # need the same alive-flag treatment; still gated
                 and not (0.0 < float(self.config.feature_fraction) < 1.0))
 
     def _make_gh_traced(self, grad, hess):
@@ -822,6 +822,27 @@ class DataParallelTreeLearner(CapabilityMixin):
                 [gh, jnp.zeros((self.R - self.N, 4), dtype=jnp.float32)],
                 axis=0)
         return jax.lax.with_sharding_constraint(gh, self.gh_sharding)
+
+    def _make_gh_quantized_traced(self, grad, hess, key):
+        """_make_gh_quantized inside the batched scan: the stochastic
+        draw runs on the UNPADDED [N] rows with the scan-carried
+        fold-in key (bit-identical to the looped path's per-tree
+        quantize_gh dispatch), then pads and shards the int rows. The
+        barrier pins the quantize output at what is a dispatch
+        boundary in the looped path — without it XLA may fuse the
+        rounding into the histogram kernels and drift the drawn
+        integers."""
+        from ..ops.quantize import _quantize_gh
+        barrier = jax.lax.optimization_barrier
+        ones = jnp.ones(self.N, dtype=jnp.float32)
+        gh, qscale = barrier(_quantize_gh(grad, hess, ones, key,
+                                          self._qmax, self._qdtype))
+        if self.R - self.N:
+            gh = jnp.concatenate(
+                [gh, jnp.zeros((self.R - self.N, 4), dtype=gh.dtype)],
+                axis=0)
+        return (barrier(jax.lax.with_sharding_constraint(
+            gh, self.gh_sharding)), qscale)
 
     def _leaf_outputs_from_records(self, recs) -> jnp.ndarray:
         """[L] final leaf outputs replayed from the record buffer: step i
@@ -841,58 +862,96 @@ class DataParallelTreeLearner(CapabilityMixin):
         out = jnp.zeros(L + 1, dtype=jnp.float32)
         return jax.lax.fori_loop(0, L - 1, body, out)[:L]
 
-    def _grow_one(self, bins, gh, feature_mask, seed, lr):
+    def _grow_one(self, bins, gh, feature_mask, seed, lr, qscale):
         """One tree inside the scan: root + whole-tree loop + leaf-output
-        replay. Returns (records, per-row output deltas [N]).
-        Exact-mode only (supports_train_many excludes quantized), so the
-        qscale passed is the constant ones."""
+        replay. Returns (records, per-row output deltas [N])."""
         barrier = jax.lax.optimization_barrier
-        state, _ = self._root_impl(bins, gh, feature_mask, seed,
-                                   self._qs_ones)
+        state, _ = self._root_impl(bins, gh, feature_mask, seed, qscale)
         state = barrier(state)
         state, recs = self._tree_impl(bins, state, feature_mask, seed,
-                                      self._qs_ones)
+                                      qscale)
         state, recs = barrier((state, recs))
         outs = self._leaf_outputs_from_records(recs) * lr
         return recs, outs[state.leaf_of_row[:self.N]]
 
-    def _many_impl(self, bins, score0, seeds, feature_mask, lr):
+    def _step_gh(self, grad, hess, qkey, ctr):
+        """Per-tree gh staging inside the scan: exact f32 rows, or —
+        quantized — advance the scan-carried tree counter and draw
+        with its fold-in key (the looped path's ops/quantize.tree_key
+        sequence, bit-exact). Returns (gh, qscale, ctr)."""
+        barrier = jax.lax.optimization_barrier
+        if qkey is None:
+            return (barrier(self._make_gh_traced(grad, hess)),
+                    self._qs_ones, ctr)
+        ctr = ctr + jnp.uint32(1)
+        gh, qscale = self._make_gh_quantized_traced(
+            grad, hess, jax.random.fold_in(qkey, ctr))
+        return gh, qscale, ctr
+
+    def _many_impl(self, bins, score0, seeds, feature_mask, lr,
+                   qkey=None, qctr0=None):
         # optimization_barrier at every boundary that is a separate
         # dispatch in the per-iteration path: without them XLA fuses the
         # gradient math into the histogram kernels, changing rounding,
         # and the batched trees drift bit-wise from the looped ones
         barrier = jax.lax.optimization_barrier
 
-        def step(score, seed):
+        def step(carry, seed):
             # score [N] (single-model objectives)
+            score, ctr, alive = carry
             grad, hess = barrier(self._many_grad_fn(score))
-            gh = barrier(self._make_gh_traced(grad, hess))
-            recs, delta = self._grow_one(bins, gh, feature_mask, seed, lr)
-            return barrier(score + delta), recs
+            gh, qscale, ctr = self._step_gh(grad, hess, qkey, ctr)
+            recs, delta = self._grow_one(bins, gh, feature_mask, seed,
+                                         lr, qscale)
+            grew = rec_valid(jax.tree_util.tree_map(
+                lambda a: a[0], recs))
+            # after a stump step the score FREEZES: a quantized redraw
+            # (new fold-in per step) may otherwise grow a tree the
+            # host — which stops applying at the first stump — never
+            # sees; dead steps also surface invalid records
+            score = barrier(jnp.where(alive, score + delta, score))
+            recs = recs._replace(
+                gain=jnp.where(alive, recs.gain, -jnp.inf))
+            return (score, ctr, alive & grew), recs
 
-        return jax.lax.scan(step, score0, seeds)
+        ctr0 = jnp.uint32(0) if qctr0 is None else qctr0
+        carry = (score0, ctr0, jnp.asarray(True))
+        (score, ctr, _), recs = jax.lax.scan(step, carry, seeds)
+        return (score, ctr), recs
 
-    def _many_impl_multi(self, bins, score0, seeds, feature_mask, lr):
+    def _many_impl_multi(self, bins, score0, seeds, feature_mask, lr,
+                         qkey=None, qctr0=None):
         # K trees per iteration (multiclass): one gradient pass per step
         # over the [N, K] scores, then a statically unrolled per-class
         # tree (reference: the k-loop of GBDT::TrainOneIter)
         barrier = jax.lax.optimization_barrier
         K = int(seeds.shape[1])
 
-        def step(score, seeds_k):
+        def step(carry, seeds_k):
+            score, ctr, alive = carry
             grad, hess = barrier(self._many_grad_fn(score))
             all_recs = []
+            grew = jnp.asarray(False)
             for k in range(K):
-                gh = barrier(self._make_gh_traced(grad[:, k], hess[:, k]))
+                gh, qscale, ctr = self._step_gh(grad[:, k], hess[:, k],
+                                                qkey, ctr)
                 recs, delta = self._grow_one(bins, gh, feature_mask,
-                                             seeds_k[k], lr)
-                score = score.at[:, k].add(delta)
+                                             seeds_k[k], lr, qscale)
+                grew = grew | rec_valid(jax.tree_util.tree_map(
+                    lambda a: a[0], recs))
+                score = score.at[:, k].add(
+                    jnp.where(alive, delta, jnp.float32(0.0)))
                 all_recs.append(recs)
             recs = jax.tree_util.tree_map(
                 lambda *a: jnp.stack(a), *all_recs)
-            return barrier(score), recs
+            recs = recs._replace(
+                gain=jnp.where(alive, recs.gain, -jnp.inf))
+            return (barrier(score), ctr, alive & grew), recs
 
-        return jax.lax.scan(step, score0, seeds)
+        ctr0 = jnp.uint32(0) if qctr0 is None else qctr0
+        carry = (score0, ctr0, jnp.asarray(True))
+        (score, ctr, _), recs = jax.lax.scan(step, carry, seeds)
+        return (score, ctr), recs
 
     def train_many(self, grad_fn, score0: jnp.ndarray, seeds,
                    shrinkage: float):
@@ -902,7 +961,10 @@ class DataParallelTreeLearner(CapabilityMixin):
         Returns (final scores, stacked SplitRecords [T, (K,) L-1]) —
         the record read-back is the batch's single host sync.
         ``grad_fn`` must be traceable (the objective's jitted gradient
-        fn)."""
+        fn). Quantized mode threads the learner's device-side tree
+        counter through the scan and stores its advanced value back,
+        so a later looped tree draws the key the looped path would
+        have drawn."""
         self._ensure_compiled()
         seeds = jnp.asarray(np.asarray(seeds, dtype=np.int32))
         # bound methods are rebuilt per attribute access: compare by
@@ -917,5 +979,16 @@ class DataParallelTreeLearner(CapabilityMixin):
         feature_mask = self._sample_features()
         self._tree_idx += int(seeds.size)
         fn = self._many_multi_fn if seeds.ndim == 2 else self._many_fn
-        return fn(self.bins, score0, seeds, feature_mask,
-                  jnp.float32(shrinkage))
+        if self._quantized:
+            out, recs = fn(self.bins, score0, seeds, feature_mask,
+                           jnp.float32(shrinkage),
+                           self._quant_base_key, self._quant_ctr)
+            score_t, self._quant_ctr = out
+            # the scan advanced the device counter once per tree slot;
+            # keep the host mirror (the _quantize_stage assert) in step
+            self._quant_ctr_host += int(seeds.size)
+        else:
+            out, recs = fn(self.bins, score0, seeds, feature_mask,
+                           jnp.float32(shrinkage))
+            score_t = out[0]
+        return score_t, recs

@@ -129,6 +129,16 @@ class CapabilityMixin:
         # base key staged once at setup: a per-tree PRNGKey(seed) would
         # be an implicit scalar transfer inside the training loop
         self._quant_base_key = jax.random.PRNGKey(self._quant_seed)
+        # device-side tree counter: the per-tree fold-in value now
+        # advances ON DEVICE (ops/quantize.tree_key), so steady-state
+        # training performs zero per-tree seed transfers (each new
+        # tree number used to be a fresh dev_u32 device_put). The host
+        # mirror below tracks the same sequence without ever reading
+        # the device value back — it exists only to ASSERT the counter
+        # stays in lockstep with the callers' tree numbering.
+        from ..utils.scalars import dev_u32
+        self._quant_ctr = dev_u32(0)
+        self._quant_ctr_host = 0
 
     def _quantize_stage(self, grad, hess, ind, tree_no: int):
         """Discretize one tree's (grad, hess, in-bag) to integer rows.
@@ -136,11 +146,18 @@ class CapabilityMixin:
         fold-in key, so learners with different row/feature padding
         (serial pads rows to 4096s, meshes to the device count) produce
         BIT-IDENTICAL quantized rows — the padding-invariance contract
-        make_rand_bins established for extra_trees."""
-        from ..ops.quantize import quantize_gh
-        from ..utils.scalars import dev_u32
-        key = jax.random.fold_in(self._quant_base_key,
-                                 dev_u32(tree_no & 0x7FFFFFFF))
+        make_rand_bins established for extra_trees. The key derives
+        from the device-side counter (``tree_key``); the assert pins
+        its sequence to the caller's ``tree_no`` (1, 2, ...) — a
+        caller off the one-call-per-tree cadence would otherwise
+        silently shift every later stochastic draw."""
+        from ..ops.quantize import quantize_gh, tree_key
+        key, self._quant_ctr = tree_key(self._quant_base_key,
+                                        self._quant_ctr)
+        self._quant_ctr_host += 1
+        assert self._quant_ctr_host == tree_no, \
+            "quantize tree counter desynced from tree numbering " \
+            "(%d != %d)" % (self._quant_ctr_host, tree_no)
         return quantize_gh(grad, hess, ind, key, self._qmax,
                            self._qdtype)
 

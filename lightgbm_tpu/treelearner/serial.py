@@ -461,14 +461,21 @@ def _finish_split(state: GrowState, rec: SplitRecord, leaf, new_leaf,
 
 def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
                 valid, mask_left, mask_right, meta, params, btab, *,
-                S: int, B: int, Bg: int, bundled: bool, max_depth: int,
+                S, B: int, Bg: int, bundled: bool, max_depth: int,
                 extra_trees: bool, has_cat: bool = True,
                 hist_impl: tuple = ("auto", False), children_allowed=None,
                 rand_seed=0, pen_left=None, pen_right=None,
                 qscale=None) -> GrowState:
     """Apply one split (already chosen: ``rec`` at ``leaf``) and scan both
-    children. Shared by the per-split and batched paths.
-    ``children_allowed`` None means: derive from device leaf_depth."""
+    children. Shared by the per-split, batched and fused paths.
+    ``children_allowed`` None means: derive from device leaf_depth.
+
+    ``S`` is the smaller-child gather size: a static int on the
+    host-stepped paths (the host buckets it per batch), or a static
+    tuple of bucket sizes on the fused whole-tree path — the device
+    then picks the branch of a ``lax.switch`` ladder from the record's
+    own child count. Fill rows hit the gh-zero dummy row, so the
+    gather size selects compiled programs, never values."""
     R = bins.shape[0]
     f = jnp.maximum(rec.feature, 0)
     col = _partition_col(bins, f, meta, btab, bundled)
@@ -482,21 +489,39 @@ def _split_body(bins, state: GrowState, rec: SplitRecord, leaf, new_leaf,
 
     smaller_is_left = rec.left_total_count <= rec.right_total_count
     small_id = jnp.where(smaller_is_left, leaf, new_leaf)
-    (idx,) = jnp.nonzero(leaf_of_row == small_id, size=S,
-                         fill_value=R - 1)
     small_totals = jnp.stack([
         jnp.where(smaller_is_left, rec.left_sum_grad, rec.right_sum_grad),
         jnp.where(smaller_is_left, rec.left_sum_hess, rec.right_sum_hess),
         jnp.where(smaller_is_left, rec.left_count, rec.right_count),
         jnp.where(smaller_is_left, rec.left_total_count,
                   rec.right_total_count)])
+
     # quantized mode: the record's totals are dequantized f32, but the
     # bundled zero-bin fix needs exact int sums — _leaf_histogram
     # recomputes them from the gathered integer rows
-    hist_small = _leaf_histogram(bins[idx], state.gh[idx], meta, btab,
-                                 B=B, Bg=Bg, bundled=bundled,
-                                 totals=small_totals,
-                                 hist_impl=hist_impl)
+    def hist_at(size: int):
+        (idx,) = jnp.nonzero(leaf_of_row == small_id, size=size,
+                             fill_value=R - 1)
+        return _leaf_histogram(bins[idx], state.gh[idx], meta, btab,
+                               B=B, Bg=Bg, bundled=bundled,
+                               totals=small_totals,
+                               hist_impl=hist_impl)
+
+    ladder = S if isinstance(S, tuple) else (S,)
+    if len(ladder) == 1:
+        hist_small = hist_at(ladder[0])
+    else:
+        # device-side bucket choice (the host `_bucket` policy, on
+        # device): smallest ladder size ≥ child count + the f32-count
+        # rounding margin; the ladder tops out at next_pow2(N), which
+        # covers any child
+        small_cnt = small_totals[3]
+        k = jnp.clip(
+            jnp.sum(jnp.asarray(ladder, dtype=jnp.float32)
+                    < small_cnt + 16.0),
+            0, len(ladder) - 1).astype(jnp.int32)
+        hist_small = jax.lax.switch(
+            k, [lambda _, s=s: hist_at(s) for s in ladder], 0)
     hist_large = subtract_histogram(state.hists[leaf], hist_small)
     hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
     hist_right = jnp.where(smaller_is_left, hist_large, hist_small)
@@ -846,6 +871,70 @@ def _batch_fn_cached(S: int, kb: int, B: int, Bg: int, bundled: bool,
                                       donate_argnums=(1,))
 
 
+def _bucket_ladder(bucket_fn, max_bucket: int) -> tuple:
+    """Every gather size ``bucket_fn`` can return, ascending — the
+    static branch ladder of the fused whole-tree grower. Each branch
+    compiles one child-histogram gather size; the padded fill rows
+    carry gh 0, so which branch runs changes compiled programs, never
+    values."""
+    sizes = {bucket_fn(0.0)}
+    c = 1
+    while c <= max_bucket:
+        sizes.add(bucket_fn(float(c)))
+        c <<= 1
+    return tuple(sorted(sizes))
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_fn_cached(L: int, B: int, Bg: int, bundled: bool,
+                     max_depth: int, extra_trees: bool,
+                     has_cat: bool = True,
+                     hist_impl: tuple = ("auto", False),
+                     ladder: tuple = ()):
+    """Fused whole-tree growth: ONE dispatch runs the entire grow loop
+    — the device argmaxes the next frontier leaf, applies the split
+    (partition update + smaller-child histogram through the gather
+    ladder + sibling subtraction), scans both children, and appends the
+    record — until no positive-gain candidate remains. The host reads
+    back only the [L-1] record buffer (the Booster-paper /
+    XGBoost-GPU "whole pipeline on the accelerator" move; the serial
+    analogue of the mesh learner's `_tree_impl`). Bit-identical to the
+    stepped `serial.batch` loop: same body, same per-step argmax, same
+    gather semantics."""
+    kb = L - 1
+
+    def fused(bins, state: GrowState, start_leaf, max_splits,
+              feature_mask, rand_seed, qscale, meta, params, btab):
+        def cond(carry):
+            i, _, _, cont = carry
+            return cont & (i < kb)
+
+        def body(carry):
+            i, state, recs, _ = carry
+            best = jnp.argmax(state.gain).astype(jnp.int32)
+            rec = _record_at(state, best)
+            valid = rec_valid(rec) & (i < max_splits)
+            recs = jax.tree_util.tree_map(
+                lambda buf, v: buf.at[i].set(v), recs, rec)
+            new_leaf = (start_leaf + i).astype(jnp.int32)
+            state = _split_body(bins, state, rec, best, new_leaf, valid,
+                                feature_mask, feature_mask, meta, params,
+                                btab, S=ladder, B=B, Bg=Bg,
+                                bundled=bundled, max_depth=max_depth,
+                                extra_trees=extra_trees, has_cat=has_cat,
+                                hist_impl=hist_impl,
+                                rand_seed=rand_seed, qscale=qscale)
+            return i + 1, state, recs, valid
+
+        carry = (jnp.int32(0), state, _empty_records(kb, B),
+                 jnp.asarray(True))
+        _, state, recs, _ = jax.lax.while_loop(cond, body, carry)
+        return state, recs
+
+    return obs_compile.instrument_jit("serial.fused_tree", fused,
+                                      donate_argnums=(1,))
+
+
 class SerialTreeLearner(CapabilityMixin):
     """Leaf-wise grower over a device-resident binned dataset."""
 
@@ -902,6 +991,11 @@ class SerialTreeLearner(CapabilityMixin):
         self._ff_rng = np.random.RandomState(config.feature_fraction_seed)
         self._resolve_constraints()
         self._max_bucket = _next_pow2(N)
+        # fused whole-tree growth (default): the entire grow loop runs
+        # as one dispatch; the stepped per-batch host loop stays behind
+        # the flag (and under the host-stepped capability drivers)
+        self._fused_growth = bool(getattr(config, "tpu_fused_tree", True))
+        self._ladder = _bucket_ladder(self._bucket, self._max_bucket)
         # extra_trees (config.h:368): random single-threshold candidates,
         # seeded per tree (host counter) and per node (device fold-in)
         self._extra_trees = bool(config.extra_trees)
@@ -942,6 +1036,12 @@ class SerialTreeLearner(CapabilityMixin):
         return (_batch_fn_cached(S, kb, self.B, self.Bg, self._bundled,
                                  self.max_depth, self._extra_trees,
                                  self._has_cat, self._hist_impl), kb)
+
+    def _fused_fn(self):
+        return _fused_fn_cached(self.L, self.B, self.Bg, self._bundled,
+                                self.max_depth, self._extra_trees,
+                                self._has_cat, self._hist_impl,
+                                self._ladder)
 
     def _batch_k(self, S: int) -> int:
         """Steps per dispatch: aim for ~4R gathered rows per batch so early
@@ -1095,10 +1195,41 @@ class SerialTreeLearner(CapabilityMixin):
         if per_node and self._forced is None:
             state = train_stepwise(self, tree, state, rec, feature_mask,
                                    rand_seed)
+        elif self._fused_growth:
+            state = self._train_fused(tree, state, feature_mask,
+                                      rand_seed, next_leaf)
         else:
             state = self._train_batched(tree, state, feature_mask,
                                         rand_seed, leaf_total, next_leaf)
         return tree, _rows_out_fn_cached(self.N)(state.leaf_of_row)
+
+    # ------------------------------------------------------------------
+    def _train_fused(self, tree: Tree, state: GrowState, feature_mask,
+                     rand_seed, next_leaf: int = 1) -> GrowState:
+        """Whole-tree device growth: one `serial.fused_tree` dispatch,
+        one record read-back (vs one per ~kb-split batch on the stepped
+        path). `next_leaf` > 1 continues after a forced-split
+        preamble."""
+        max_splits = self.L - next_leaf
+        if max_splits <= 0:
+            return state
+        fn = self._fused_fn()
+        with obs.scope("tree::split_batches"):
+            state, recs = fn(self.bins, state, dev_i32(next_leaf),
+                             dev_i32(max_splits), feature_mask,
+                             rand_seed, self._qscale, self.meta,
+                             self.params, self._btab)
+            # jaxlint: disable=JLT001 -- THE per-tree host sync of the
+            # fused path: the whole tree's split records read back in
+            # one deliberate hop (the grow loop itself never syncs)
+            recs_h = jax.device_get(recs)
+        with obs.scope("tree::apply_records"):
+            for i in range(max_splits):
+                r = jax.tree_util.tree_map(lambda a: a[i], recs_h)
+                if not record_is_valid(r):
+                    break
+                apply_split_record(tree, self.dataset, r)
+        return state
 
     # ------------------------------------------------------------------
     def _train_batched(self, tree: Tree, state: GrowState,
@@ -1119,9 +1250,10 @@ class SerialTreeLearner(CapabilityMixin):
                                  dev_i32(max_splits), feature_mask,
                                  rand_seed, self._qscale, self.meta,
                                  self.params, self._btab)
-                # jaxlint: disable=JLT001 -- THE per-batch host sync:
-                # the split records must reach the host Tree (one
-                # deliberate round-trip per ~log2(L) batch)
+                # jaxlint: disable=JLT001 -- the LEGACY stepped path's
+                # per-batch host sync (tpu_fused_tree=false; also the
+                # fused path's bit-parity reference): the split records
+                # must reach the host Tree once per ~log2(L) batch
                 recs_h = jax.device_get(recs)
             stop = False
             with obs.scope("tree::apply_records"):

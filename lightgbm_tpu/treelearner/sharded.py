@@ -51,16 +51,18 @@ from ..io.shards import ShardedBinnedDataset, ShardPrefetcher
 from ..models.tree import Tree
 from ..obs import compile as obs_compile
 from ..obs.registry import registry as obs
-from ..ops.histogram import resolve_hist_impl, subtract_histogram
+from ..ops.histogram import mask_gh, resolve_hist_impl, subtract_histogram
 from ..ops.quantize import acc_dtype, dequantize_sums, sum_gh
 from ..ops.split import (FeatureMeta, SplitParams, calculate_leaf_output,
-                         find_best_split, pad_feature_meta)
+                         find_best_split, pad_feature_meta,
+                         select_frontier)
 from ..utils import log, next_pow2 as _next_pow2
 from ..utils.scalars import dev_bool, dev_i32
 from .capabilities import CapabilityMixin
 from .serial import (_finish_split, _go_left_by_bin, _maybe_rand_bins,
                      _pad_rows_fn_cached, _record_at, _stage_gh_fn_cached,
-                     apply_split_record, make_root_state, record_is_valid)
+                     apply_split_record, make_root_state, rec_valid,
+                     record_is_valid)
 
 
 def _accum_hist(hist: jnp.ndarray, bins: jnp.ndarray,
@@ -212,6 +214,208 @@ def _finish_fn_cached(B: int, max_depth: int, extra_trees: bool,
                                       donate_argnums=(0,))
 
 
+# ----------------------------------------------------------------------
+# K-splits-per-sweep frontier batching. One shard staging serves up to
+# K pending splits: the round SPECULATES the top-K best-split
+# candidates of the current store (slot 0 pinned to the argmax —
+# ops/split.py select_frontier), applies all K partition routings and
+# histograms all K smaller children in a single sweep, then a
+# device-side finish VALIDATES the leaf-wise order split by split —
+# a speculated slot is accepted only while the store argmax still
+# names it, exactly reproducing the sequential grower's choices (a
+# freshly-scanned child that out-gains the next pending candidate
+# rejects the tail). Rejected slots' partition routings are reverted
+# at the next staging (their new-leaf ids are about to be reused), and
+# their histograms are discarded — wasted compute, but the staging
+# traffic (the out-of-core bottleneck) is paid ONCE per round instead
+# of once per split. Trees stay BIT-identical to serial growth:
+# accepted splits perform the identical ordered scatter-adds and scans
+# the one-split-per-sweep path performs.
+# ----------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _zero_khist_fn_cached(K: int, Fp: int, B: int, dtype_name: str):
+    """Fresh [K, Fp, B, 4] per-slot accumulator block per sweep round
+    (jitted constant, like _zero_hist_fn_cached)."""
+    dtype = jnp.dtype(dtype_name)
+
+    def zero():
+        return jnp.zeros((K, Fp, B, 4), dtype=dtype)
+
+    return obs_compile.instrument_jit("sharded.zero_khist", zero)
+
+
+def _slot(recs, i: int):
+    """Record ``i`` of a [K]-stacked SplitRecord."""
+    return jax.tree_util.tree_map(lambda a: a[i], recs)
+
+
+def _spec_records(state, K: int):
+    """Stacked top-K speculation records. The record gain carries the
+    SELECTION value from select_frontier — -inf on dead slots even
+    when their index aliases a live leaf — so host
+    ``record_is_valid`` and device ``rec_valid`` both reject exactly
+    the slots the selection did not really pick."""
+    leaves, vals = select_frontier(state.gain, K)
+    return _record_at(state, leaves)._replace(gain=vals)
+
+
+def _shard_kstep(shard_bins, leaf_seg, gh_seg, hists, recs,
+                 new_leaf_base, spec_valid, revert_from, revert_to,
+                 meta, K: int, S: int):
+    """One shard's slice of a K-split sweep round.
+
+    1. revert the previous round's REJECTED routings (their new-leaf
+       ids are reused by this round's slots, so this must precede the
+       new updates); ``revert_from`` is -1 on non-rejected slots, and
+       the explicit ``>= 0`` guard keeps the -1 sentinel from
+       matching the pad rows' leaf -1;
+    2. apply the K speculated partition updates — the speculated
+       leaves are distinct (one pending candidate per leaf), so the
+       updates commute and match the sequential per-split routing;
+    3. gather + scatter each slot's smaller child into its running
+       histogram. Child ``i``'s membership is unaffected by the other
+       slots' routings (distinct source and target leaf ids), so the
+       gathered rows — and the ordered adds — are exactly the
+       sequential sweep's.
+
+    ``S`` is one static gather bucket for all K slots (the max of the
+    slots' smaller-child buckets, host-chosen); fill rows hit the
+    shard's zero pad row."""
+    n_pad = shard_bins.shape[0]
+    leaf_seg = _apply_reverts(leaf_seg, revert_from, revert_to, K)
+    for i in range(K):
+        rec = _slot(recs, i)
+        f = jnp.maximum(rec.feature, 0)
+        col = jnp.take(shard_bins, f, axis=1).astype(jnp.int32)
+        gl = _go_left_by_bin(col, rec.threshold_bin, rec.default_left,
+                             meta.missing_type[f], meta.num_bin[f] - 1,
+                             meta.zero_bin[f], rec.is_categorical,
+                             rec.cat_mask)
+        on_leaf = leaf_seg == rec.leaf
+        leaf_seg = jnp.where(spec_valid[i] & on_leaf & ~gl,
+                             new_leaf_base + i, leaf_seg)
+    for i in range(K):
+        rec = _slot(recs, i)
+        smaller_is_left = rec.left_total_count <= rec.right_total_count
+        small_id = jnp.where(smaller_is_left, rec.leaf,
+                             new_leaf_base + i)
+        (idx,) = jnp.nonzero(leaf_seg == small_id, size=S,
+                             fill_value=n_pad - 1)
+        # invalid slots still gather (static shapes) but their rows are
+        # zeroed so the slot histogram stays null
+        gh_rows = mask_gh(gh_seg[idx], spec_valid[i])
+        hists = hists.at[i].set(
+            _accum_hist(hists[i], shard_bins[idx], gh_rows))
+    return leaf_seg, hists
+
+
+_shard_kstep_fn = obs_compile.instrument_jit(
+    "sharded.shard_kstep", _shard_kstep, static_argnums=(10, 11))
+
+
+def _apply_reverts(leaf_seg, revert_from, revert_to, K: int):
+    """Undo the previous round's rejected routings on one shard
+    segment. ``revert_from`` is -1 on non-rejected slots; the explicit
+    ``>= 0`` guard keeps the sentinel from matching the pad rows' leaf
+    -1. Shared by the in-sweep revert (``_shard_kstep``) and the
+    post-loop cleanup (``_revert_fn_cached``) — the two MUST apply
+    identical rules or the partition handed to the score update
+    desyncs from what the next sweep assumed."""
+    for j in range(K):
+        hit = (revert_from[j] >= 0) & (leaf_seg == revert_from[j])
+        leaf_seg = jnp.where(hit, revert_to[j], leaf_seg)
+    return leaf_seg
+
+
+@functools.lru_cache(maxsize=None)
+def _revert_fn_cached(K: int):
+    """Standalone revert of rejected routings — applied to every shard
+    segment after the grow loop ends with rejections still pending
+    (no further sweep will fold the revert in)."""
+    def revert(leaf_seg, revert_from, revert_to):
+        return _apply_reverts(leaf_seg, revert_from, revert_to, K)
+
+    return obs_compile.instrument_jit("sharded.revert", revert)
+
+
+@functools.lru_cache(maxsize=None)
+def _kfinish_fn_cached(B: int, K: int, max_depth: int, extra_trees: bool,
+                       has_cat: bool):
+    """Validated finish of one K-split sweep round: slot by slot —
+    check the store argmax still names the speculated leaf (the
+    sequential grower's choice), then masked sibling subtraction +
+    per-leaf store updates + both children's scans (the shared
+    ``_finish_split`` tail). The first rejected slot kills the rest of
+    the round (`alive` chain): their state writes are suppressed and
+    the host reverts their routings next staging. Returns the
+    accepted mask; the NEXT round's speculation comes from the
+    separate gather-only ``_spec_fn`` dispatch (an in-jit epilogue
+    was measured to shift the scans' f32 sums an ulp off the
+    one-split compile — see ``_spec_fn_cached``)."""
+    def kfinish(state, recs, hists, new_leaf_base, spec_valid,
+                feature_mask, rand_seed, qscale, meta, params):
+        accepted = jnp.zeros(K, dtype=bool)
+        alive = jnp.asarray(True)
+        for i in range(K):
+            # barrier between slots: each slot's subtraction + child
+            # scans must compile like the one-split finish dispatch —
+            # cross-slot fusion is free to contract a dequantize
+            # multiply into an FMA and drift the stored gains by an
+            # ulp off the stepped path (the train_many precedent)
+            state = jax.lax.optimization_barrier(state)
+            rec = _slot(recs, i)
+            is_next = (jnp.argmax(state.gain).astype(jnp.int32)
+                       == rec.leaf)
+            ok = alive & spec_valid[i] & is_next & rec_valid(rec)
+            new_leaf = (new_leaf_base + i).astype(jnp.int32)
+            leaf = rec.leaf
+            smaller_is_left = (rec.left_total_count
+                               <= rec.right_total_count)
+            hist_small = hists[i]
+            hist_large = subtract_histogram(state.hists[leaf],
+                                            hist_small)
+            hist_left = jnp.where(smaller_is_left, hist_small,
+                                  hist_large)
+            hist_right = jnp.where(smaller_is_left, hist_large,
+                                   hist_small)
+            hs = state.hists \
+                .at[leaf].set(jnp.where(ok, hist_left,
+                                        state.hists[leaf])) \
+                .at[new_leaf].set(jnp.where(ok, hist_right,
+                                            state.hists[new_leaf]))
+            state = state._replace(hists=hs)
+            state = _finish_split(state, rec, leaf, new_leaf, ok,
+                                  hist_left, hist_right, feature_mask,
+                                  feature_mask, meta, params,
+                                  max_depth=max_depth,
+                                  extra_trees=extra_trees,
+                                  has_cat=has_cat, rand_seed=rand_seed,
+                                  qscale=qscale)
+            accepted = accepted.at[i].set(ok)
+            alive = ok
+        return state, accepted
+
+    return obs_compile.instrument_jit("sharded.kfinish", kfinish,
+                                      donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _spec_fn_cached(K: int):
+    """Top-K speculation records off an existing GrowState — pure
+    gathers (select_frontier + _record_at), no split math. Runs as its
+    OWN dispatch after the shared ``_root_fn``: compiling a combined
+    root+spec program was measured to shift the root scan's f32
+    cumsum sums by an ulp against the one-split path (XLA refuses the
+    same contraction choices under a different epilogue), breaking
+    bit parity; a gather-only follow-up dispatch cannot."""
+    def spec_of(state):
+        return _spec_records(state, K)
+
+    return obs_compile.instrument_jit("sharded.spec", spec_of)
+
+
 @functools.lru_cache(maxsize=None)
 def _rows_out_fn_cached(sizes: tuple):
     """Per-shard leaf segments → the full [N] row→leaf vector the
@@ -284,9 +488,27 @@ class ShardedTreeLearner(CapabilityMixin):
         self._leaf0 = jnp.zeros(1, dtype=jnp.int32)
         self._root_fn = _root_fn_cached(self.L, self.B,
                                         self._extra_trees, self._has_cat)
+        # K pending splits per shard sweep (frontier batching): each
+        # staging pass serves up to K splits; 0/1 keeps the legacy
+        # one-split-per-sweep loop (also the K-batch's bit-parity
+        # reference)
+        self._K = max(1, min(
+            int(getattr(config, "tpu_frontier_splits", 8)), self.L - 1))
+        self._rebind_compiled()
+
+    def _rebind_compiled(self) -> None:
+        """(Re)resolve the lru-cached step programs from the current
+        static config (max_depth bakes into finish/kfinish) — called
+        at setup and again by ops_refresh.refresh_learner_params after
+        a reset_parameter."""
         self._finish_fn = _finish_fn_cached(self.B, self.max_depth,
                                             self._extra_trees,
                                             self._has_cat)
+        if self._K > 1:
+            self._spec_fn = _spec_fn_cached(self._K)
+            self._kfinish_fn = _kfinish_fn_cached(
+                self.B, self._K, self.max_depth, self._extra_trees,
+                self._has_cat)
 
     # ------------------------------------------------------------------
     def _check_unsupported(self, config) -> None:
@@ -321,6 +543,10 @@ class ShardedTreeLearner(CapabilityMixin):
     def _zero_hist(self):
         return _zero_hist_fn_cached(self.Fp, self.B, self._hist_dtype)()
 
+    def _zero_khist(self):
+        return _zero_khist_fn_cached(self._K, self.Fp, self.B,
+                                     self._hist_dtype)()
+
     # ------------------------------------------------------------------
     def train(self, grad, hess, bag=None):
         """Grow one tree over the shard sweep; returns the host Tree and
@@ -346,22 +572,51 @@ class ShardedTreeLearner(CapabilityMixin):
             for n, p, o in zip(self._sizes, self._pads, self._offsets)]
         leaf_segs = list(self._leaf_seg0)
 
+        if self._K > 1:
+            leaf_segs = self._grow_kbatch(tree, gh, gh_segs, leaf_segs,
+                                          feature_mask, rand_seed)
+        else:
+            leaf_segs = self._grow_stepped(tree, gh, gh_segs, leaf_segs,
+                                           feature_mask, rand_seed)
+        rows_out = _rows_out_fn_cached(tuple(self._sizes))
+        return tree, rows_out(*leaf_segs)
+
+    # ------------------------------------------------------------------
+    def _root_round(self, gh, gh_segs, feature_mask, rand_seed):
+        """Root round shared by BOTH growth strategies — the lockstep
+        matters: the K-batch's bit-parity contract rests on the SAME
+        `sharded.root` compile and the same staging/prestart
+        discipline as the stepped path. Accumulates the root histogram
+        over one staging sweep, scans it, prestarts the first split
+        round's sweep through the read-back window, and reads back the
+        chosen record (stepped) or the top-K speculation (K-batch).
+        Returns (state, recs_dev, recs_host, pending_sweep)."""
+        hist = self._zero_hist()
+        for k, bins_dev in self.prefetcher.sweep():
+            hist = _accum_hist_fn(hist, bins_dev, gh_segs[k])
+        sums_raw = _sum_gh_fn(gh)
+        state, rec = self._root_fn(
+            hist, sums_raw, self._gh0, self._leaf0, feature_mask,
+            dev_bool(self._splittable(0)), rand_seed, self._qscale,
+            self.meta, self.params)
+        out = rec if self._K <= 1 else self._spec_fn(state)
+        # prestart the first split's sweep: shard 0 stages through
+        # the root read-back window instead of after it
+        pending = self.prefetcher.sweep() if self.L > 1 else None
+        # jaxlint: disable=JLT001 -- the root record(s) must reach the
+        # host Tree replay (one deliberate sync per tree root)
+        out_h = jax.device_get(out)
+        obs.watch_ready("tree::root_histogram", out)
+        return state, out, out_h, pending
+
+    # ------------------------------------------------------------------
+    def _grow_stepped(self, tree, gh, gh_segs, leaf_segs, feature_mask,
+                      rand_seed):
+        """Legacy one-split-per-sweep growth (tpu_frontier_splits<=1;
+        also the K-batch's bit-parity reference)."""
         with obs.scope("tree::root_histogram"):
-            hist = self._zero_hist()
-            for k, bins_dev in self.prefetcher.sweep():
-                hist = _accum_hist_fn(hist, bins_dev, gh_segs[k])
-            sums_raw = _sum_gh_fn(gh)
-            state, rec = self._root_fn(
-                hist, sums_raw, self._gh0, self._leaf0, feature_mask,
-                dev_bool(self._splittable(0)), rand_seed, self._qscale,
-                self.meta, self.params)
-            # prestart the first split's sweep: shard 0 stages through
-            # the root read-back window instead of after it
-            pending = self.prefetcher.sweep() if self.L > 1 else None
-            # jaxlint: disable=JLT001 -- the root split record must
-            # reach the host Tree (one deliberate sync per tree root)
-            rec_h = jax.device_get(rec)
-            obs.watch_ready("tree::root_histogram", rec)
+            state, rec, rec_h, pending = self._root_round(
+                gh, gh_segs, feature_mask, rand_seed)
 
         next_leaf = 1
         while next_leaf < self.L:
@@ -396,9 +651,97 @@ class ShardedTreeLearner(CapabilityMixin):
             apply_split_record(tree, self.dataset, rec_h)
             next_leaf += 1
             rec, rec_h = next_rec, next_rec_h
+        return leaf_segs
 
-        rows_out = _rows_out_fn_cached(tuple(self._sizes))
-        return tree, rows_out(*leaf_segs)
+    # ------------------------------------------------------------------
+    def _grow_kbatch(self, tree, gh, gh_segs, leaf_segs, feature_mask,
+                     rand_seed):
+        """K-splits-per-sweep growth (module docstring above the
+        k-batch device functions): each round speculates the top-K
+        pending candidates, serves all K from ONE staging pass, and
+        the validated finish accepts the leaf-wise-order-preserving
+        prefix. One host sync per ROUND instead of per split."""
+        K = self._K
+        with obs.scope("tree::root_histogram"):
+            state, spec, spec_h, pending = self._root_round(
+                gh, gh_segs, feature_mask, rand_seed)
+
+        next_leaf = 1
+        rev_from = np.full(K, -1, dtype=np.int32)
+        rev_to = np.zeros(K, dtype=np.int32)
+        while next_leaf < self.L:
+            slots = [_slot(spec_h, i) for i in range(K)]
+            n_slots = min(K, self.L - next_leaf)
+            # speculation validity is a prefix: slots come gain-sorted
+            n_valid = 0
+            while n_valid < n_slots and record_is_valid(slots[n_valid]):
+                n_valid += 1
+            if n_valid == 0:
+                break
+            small_max = max(
+                min(float(slots[i].left_total_count),
+                    float(slots[i].right_total_count))
+                for i in range(n_valid))
+            # explicit device staging of the round's control vectors
+            # (transfer-guard discipline: one deliberate device_put
+            # per round, never an implicit transfer)
+            sv_dev = jax.device_put(
+                np.arange(K, dtype=np.int32) < n_valid)
+            rf_dev = jax.device_put(rev_from)
+            rt_dev = jax.device_put(rev_to)
+            nlb = dev_i32(next_leaf)
+            if pending is None:
+                # the previous round's rejections forced an extra
+                # round the prestart heuristic did not cover
+                pending = self.prefetcher.sweep()
+            with obs.scope("tree::shard_sweep"):
+                hists = self._zero_khist()
+                for k, bins_dev in pending:
+                    S = min(max(_next_pow2(int(small_max) + 16),
+                                _MIN_BUCKET), self._pads[k])
+                    leaf_segs[k], hists = _shard_kstep_fn(
+                        bins_dev, leaf_segs[k], gh_segs[k], hists,
+                        spec, nlb, sv_dev, rf_dev, rt_dev, self.meta,
+                        K, S)
+            # prestart the next round's staging only when even a fully
+            # accepted round leaves splits to grow (a rejected tail
+            # instead pays one stall at the loop top)
+            pending = (self.prefetcher.sweep()
+                       if next_leaf + n_valid < self.L else None)
+            with obs.scope("tree::split_scan"):
+                state, accepted = self._kfinish_fn(
+                    state, spec, hists, nlb, sv_dev, feature_mask,
+                    rand_seed, self._qscale, self.meta, self.params)
+                spec = self._spec_fn(state)
+                # jaxlint: disable=JLT001 -- THE per-round host sync:
+                # the accepted mask plus the next round's speculation
+                # read back in one hop (the K-batch analogue of the
+                # stepped path's per-split read-back)
+                accepted_h, spec_h = jax.device_get((accepted, spec))
+            n_acc = 0
+            while n_acc < K and bool(accepted_h[n_acc]):
+                n_acc += 1
+            for i in range(n_acc):
+                apply_split_record(tree, self.dataset, slots[i])
+            rev_from = np.full(K, -1, dtype=np.int32)
+            rev_to = np.zeros(K, dtype=np.int32)
+            for i in range(n_acc, n_valid):
+                rev_from[i] = next_leaf + i
+                rev_to[i] = int(slots[i].leaf)
+            next_leaf += n_acc
+            if n_acc == 0:
+                break  # defensive: slot 0 is argmax-pinned
+
+        if (rev_from >= 0).any():
+            # the loop ended with rejected routings still applied:
+            # revert them before the partition feeds the score update
+            # (no further sweep folds the revert in)
+            rf_dev = jax.device_put(rev_from)
+            rt_dev = jax.device_put(rev_to)
+            rev = _revert_fn_cached(K)
+            for k in range(len(leaf_segs)):
+                leaf_segs[k] = rev(leaf_segs[k], rf_dev, rt_dev)
+        return leaf_segs
 
 
 _accum_hist_fn = obs_compile.instrument_jit("sharded.accum_hist",
