@@ -675,6 +675,171 @@ def run_oocore_bench() -> dict:
     }
 
 
+def run_chaos_bench() -> dict:
+    """Chaos stage (``python bench.py chaos`` or BENCH_CHAOS=1): run
+    training under a deterministic fault-injection schedule and prove
+    the fault-tolerant plane absorbs it — 1 prefetch staging fault
+    (retried), 1 spill ENOSPC fault (degraded to resident shards,
+    bit-identical model), and 1 SIGKILL mid-train + checkpoint resume
+    (bit-identical to the uninterrupted control run).
+
+    First-class keys: ``chaos_faults_injected`` (total injected),
+    ``chaos_recovered`` (faults the run absorbed without dying),
+    ``chaos_resume_overhead_pct`` (wall cost of the resume leg —
+    checkpoint load + remaining iterations — vs the same iterations of
+    the uninterrupted run). Exit nonzero on any lost fault or a
+    non-identical resumed model.
+
+    Env knobs: BENCH_CHAOS_ROWS (40k), BENCH_CHAOS_ITERS (8),
+    BENCH_CHAOS_KILL_AT (ITERS//2).
+    """
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import textwrap
+
+    import jax
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.ft import checkpoint as ckpt_mod
+    from lightgbm_tpu.io.shards import ShardedBinnedDataset
+    from lightgbm_tpu.obs import faults
+    from lightgbm_tpu.obs import health as obs_health
+    from lightgbm_tpu.obs.registry import registry as obs_registry
+
+    _enable_compile_cache()
+    platform = jax.devices()[0].platform
+    obs_registry.enable()
+    obs_health.record_backend(platform, source="bench_chaos")
+
+    rows = int(os.environ.get("BENCH_CHAOS_ROWS", 40_000))
+    iters = int(os.environ.get("BENCH_CHAOS_ITERS", 8))
+    kill_at = int(os.environ.get("BENCH_CHAOS_KILL_AT", max(iters // 2,
+                                                            1)))
+    n_feat = 28
+    params = {"objective": "binary", "num_leaves": 31, "max_bin": 255,
+              "verbosity": -1, "min_data_in_leaf": 20,
+              "bin_construct_sample_cnt": 20_000}
+    work = tempfile.mkdtemp(prefix="lgbm_tpu_chaos_")
+    injected0 = obs_registry.count("ft/faults_injected")
+    faults_survived = 0
+
+    # ---- leg 1: sharded training under prefetch + spill faults ------
+    X, y = make_higgs_like(rows, n_feat, seed=7)
+
+    def source():
+        for lo in range(0, rows, 10_000):
+            yield X[lo:lo + 10_000], y[lo:lo + 10_000].astype(
+                np.float32)
+
+    _stage("chaos_faults_start", rows=rows)
+    cfg = lambda extra=None: Config.from_params(  # noqa: E731
+        dict(params, **(extra or {})))
+    ds_clean = ShardedBinnedDataset.from_chunk_source(
+        source, cfg(), os.path.join(work, "sp_clean"),
+        shard_rows=rows // 4, total_rows=rows)
+    b_clean = create_boosting(cfg({"num_iterations": 2}), ds_clean)
+    for _ in range(2):
+        b_clean.train_one_iter()
+
+    faults.configure("spill_write:nth:2:ENOSPC;"
+                     "prefetch_device_put:nth:3")
+    try:
+        ds_chaos = ShardedBinnedDataset.from_chunk_source(
+            source, cfg(), os.path.join(work, "sp_chaos"),
+            shard_rows=rows // 4, total_rows=rows)
+        b_chaos = create_boosting(cfg({"num_iterations": 2}), ds_chaos)
+        for _ in range(2):
+            b_chaos.train_one_iter()
+    finally:
+        faults.reset()
+    faults_ok = (b_chaos.save_model_to_string()
+                 == b_clean.save_model_to_string())
+    if faults_ok:
+        faults_survived += 2          # spill degrade + prefetch retry
+    _stage("chaos_faults_done", identical=faults_ok,
+           resident_shards=len(ds_chaos._resident_shards),
+           retries=obs_registry.count("ft/retries"))
+
+    # ---- leg 2: SIGKILL mid-train + resume --------------------------
+    ckdir = os.path.join(work, "ck")
+    child = textwrap.dedent("""\
+        import os, signal
+        import numpy as np
+        import bench
+        import lightgbm_tpu as lgb
+        X, y = bench.make_higgs_like(%(rows)d, %(n_feat)d, seed=7)
+        def killer(env):
+            if env.iteration + 1 == %(kill_at)d:
+                os.kill(os.getpid(), signal.SIGKILL)
+        lgb.train(%(params)r, lgb.Dataset(X, label=y),
+                  num_boost_round=%(iters)d,
+                  checkpoint_dir=%(ckdir)r, checkpoint_freq=1,
+                  callbacks=[killer])
+        """) % dict(rows=rows, n_feat=n_feat, kill_at=kill_at,
+                    iters=iters, params=params, ckdir=ckdir)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.abspath(__file__)),
+         env.get("PYTHONPATH", "")]).rstrip(os.pathsep)
+    t0 = time.time()
+    proc = subprocess.run([sys.executable, "-c", child], env=env,
+                          capture_output=True, timeout=1200)
+    killed_ok = proc.returncode == -signal.SIGKILL \
+        and bool(ckpt_mod.list_checkpoints(ckdir))
+    _stage("chaos_killed", returncode=proc.returncode,
+           checkpoints=len(ckpt_mod.list_checkpoints(ckdir)),
+           t_killed_leg=round(time.time() - t0, 1))
+
+    t0 = time.time()
+    control = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                        num_boost_round=iters)
+    t_control = time.time() - t0
+    t0 = time.time()
+    resumed = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                        num_boost_round=iters, checkpoint_dir=ckdir,
+                        resume=True)
+    t_resume = time.time() - t0
+    resume_ok = killed_ok and (
+        resumed.inner.save_model_to_string()
+        == control.inner.save_model_to_string())
+    if resume_ok:
+        faults_survived += 1          # the kill itself
+    # the resume leg re-binns the data + loads the checkpoint, then
+    # trains iters - kill_at iterations; compare against the same
+    # fraction of the uninterrupted run's wall time
+    t_fair = t_control * max(iters - kill_at, 1) / iters
+    overhead_pct = 100.0 * (t_resume - t_fair) / max(t_fair, 1e-9)
+
+    injected = obs_registry.count("ft/faults_injected") - injected0
+    recovered_all = faults_ok and resume_ok
+    _stage("chaos_done", injected=injected,
+           recovered=faults_survived,
+           resume_overhead_pct=round(overhead_pct, 1),
+           identical=recovered_all)
+    if not os.environ.get("BENCH_CHAOS_KEEP"):
+        shutil.rmtree(work, ignore_errors=True)
+    return {
+        "metric": "chaos_recovered",
+        "value": faults_survived,
+        "unit": "faults survived of %d injected on %s (1 spill ENOSPC "
+                "degrade + 1 prefetch retry + 1 SIGKILL@iter%d/%d "
+                "resume; models bit-identical: %s; resume leg %+.0f%% "
+                "vs uninterrupted)"
+                % (injected, platform, kill_at, iters, recovered_all,
+                   overhead_pct),
+        "backend": platform,
+        "chaos_faults_injected": injected,
+        "chaos_recovered": faults_survived,
+        "chaos_resume_overhead_pct": round(overhead_pct, 1),
+        "chaos_bit_identical": bool(recovered_all),
+    }
+
+
 def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
     if n_rows is None:
         n_rows = int(os.environ.get("BENCH_ROWS", HIGGS_ROWS))
@@ -994,6 +1159,28 @@ def main() -> None:
             sys.exit(1)
         print(json.dumps(result))
         if not result["rss_ok"]:
+            sys.exit(1)
+        return
+    if (os.environ.get("BENCH_CHAOS")
+            or (len(sys.argv) > 1 and sys.argv[1] == "chaos")):
+        # chaos stage: fault injection + kill/resume are host+any-device
+        if os.environ.get("JAX_PLATFORMS") in (None, "") \
+                and not os.environ.get("PALLAS_AXON_POOL_IPS"):
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            result = run_chaos_bench()
+        except Exception as e:
+            result = {"metric": "chaos_recovered", "value": 0,
+                      "unit": "faults survived (FAILED: %s: %s)"
+                              % (type(e).__name__, str(e)[:300]),
+                      "chaos_faults_injected": 0,
+                      "chaos_recovered": 0,
+                      "chaos_resume_overhead_pct": 0.0,
+                      "chaos_bit_identical": False}
+            print(json.dumps(result))
+            sys.exit(1)
+        print(json.dumps(result))
+        if not result.get("chaos_bit_identical"):
             sys.exit(1)
         return
     if (os.environ.get("BENCH_HIST")

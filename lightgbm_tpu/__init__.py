@@ -8,7 +8,7 @@ rows over a ``jax.sharding.Mesh`` and reduces histograms with ICI
 collectives. The Python surface mirrors the reference's
 ``lightgbm`` package (Dataset/Booster/train/cv/sklearn wrappers).
 """
-from . import obs, serve
+from . import ft, obs, serve
 from .basic import Booster, Dataset, LightGBMError
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)
@@ -28,5 +28,5 @@ __all__ = [
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "plot_importance", "plot_metric", "plot_split_value_histogram",
     "plot_tree", "create_tree_digraph",
-    "obs", "serve",
+    "obs", "serve", "ft",
 ]

@@ -785,14 +785,21 @@ class GBDT:
     # ------------------------------------------------------------------
     def train(self, snapshot_freq: int = -1,
               model_output_path: str = "",
-              callbacks: Optional[Sequence[Callable]] = None) -> None:
+              callbacks: Optional[Sequence[Callable]] = None,
+              checkpoint_dir: str = "",
+              checkpoint_freq: int = -1) -> None:
         """Full training loop (reference: GBDT::Train, gbdt.cpp:229).
 
         metric_freq gates only the *printing* of metrics; early stopping
         evaluates every iteration like the reference (OutputMetric runs
         whenever early_stopping_round > 0, gbdt.cpp:461). ``callbacks``
         follow the python callback protocol (CallbackEnv; EarlyStopException
-        stops training)."""
+        stops training).
+
+        ``checkpoint_dir`` + ``checkpoint_freq`` write crash-consistent
+        resume checkpoints (ft/checkpoint.py) — unlike ``snapshot_freq``
+        model snapshots these capture scores + RNG state, so a killed
+        run resumes bit-identically via :meth:`load_checkpoint`."""
         from ..callback import CallbackEnv, EarlyStopException
         callbacks = list(callbacks or [])
         cbs_before = sorted(
@@ -849,8 +856,13 @@ class GBDT:
                     and model_output_path:
                 self.save_model(model_output_path
                                 + ".snapshot_iter_%d" % self.iter)
+            if checkpoint_dir and checkpoint_freq > 0 \
+                    and self.iter % checkpoint_freq == 0:
+                self.save_checkpoint(checkpoint_dir)
             if finished:
                 break
+        if checkpoint_dir:
+            self.save_checkpoint(checkpoint_dir)
 
     # ------------------------------------------------------------------
     # Prediction over raw feature matrices (host)
@@ -1012,9 +1024,14 @@ class GBDT:
 
     def save_model(self, filename: str, start_iteration: int = 0,
                    num_iteration: int = -1) -> None:
-        with open(filename, "w") as f:
-            f.write(self.save_model_to_string(start_iteration,
-                                              num_iteration))
+        # tmp+rename: a crash mid-write must leave the previous model
+        # file (or nothing), never a truncated one that parses as a
+        # shorter model — the same discipline as trace segments and
+        # checkpoints (utils/atomic.py)
+        from ..utils.atomic import atomic_write
+        atomic_write(filename,
+                     self.save_model_to_string(start_iteration,
+                                               num_iteration))
 
     def dump_model(self, start_iteration: int = 0,
                    num_iteration: int = -1,
@@ -1068,8 +1085,30 @@ class GBDT:
         """``convert_model`` task output (reference:
         GBDT::SaveModelToIfElse, gbdt_model_text.cpp:286)."""
         from ..models.codegen import model_to_cpp
-        with open(filename, "w") as f:
-            f.write(model_to_cpp(self))
+        from ..utils.atomic import atomic_write
+        atomic_write(filename, model_to_cpp(self))
+
+    # ------------------------------------------------------------------
+    # Crash-consistent checkpoint/resume (ft/checkpoint.py)
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, directory: str,
+                        keep: Optional[int] = None) -> str:
+        """Write one atomically-finalized checkpoint directory holding
+        the FULL resume state — trees, iteration/early-stop
+        bookkeeping, every RNG sequence position (bagging/GOSS/DART/
+        feature-fraction/quantize counters), and the training-score
+        bits. Resuming via :meth:`load_checkpoint` continues the run
+        bit-identically (docs/RELIABILITY.md)."""
+        from ..ft import checkpoint as _ckpt
+        return _ckpt.save(self, directory, keep=keep)
+
+    def load_checkpoint(self, directory: str) -> Optional[Dict]:
+        """Restore this (freshly initialized, same-dataset) booster
+        from the newest valid checkpoint under ``directory``; returns
+        the checkpoint state dict, or None when no valid checkpoint
+        exists. Corrupt checkpoints are skipped loudly."""
+        from ..ft import checkpoint as _ckpt
+        return _ckpt.load_latest(self, directory)
 
     def load_model_from_string(self, s: str) -> None:
         """reference: GBDT::LoadModelFromString
@@ -1096,23 +1135,9 @@ class GBDT:
         if "objective" in kv:
             self.objective = load_objective_from_string(
                 kv["objective"], self.config)
-        # parse trees
-        self.models = []
-        cur: List[str] = []
-        in_tree = False
-        for line in lines[i:]:
-            if line.startswith("Tree="):
-                if cur:
-                    self.models.append(Tree.from_string("\n".join(cur)))
-                cur = []
-                in_tree = True
-            elif line.strip() == "end of trees":
-                if cur:
-                    self.models.append(Tree.from_string("\n".join(cur)))
-                cur = []
-                in_tree = False
-            elif in_tree:
-                cur.append(line)
+        # parse trees (shared block parser: models/tree.py)
+        from ..models.tree import parse_tree_blocks
+        self.models = parse_tree_blocks("\n".join(lines[i:]))
         self.num_init_iteration = \
             len(self.models) // max(self.num_tree_per_iteration, 1)
         self.iter = 0

@@ -39,8 +39,20 @@ def train(params: Dict[str, Any], train_set: Dataset,
           feval: Optional[Union[Callable, List[Callable]]] = None,
           init_model: Optional[Union[str, Booster]] = None,
           keep_training_booster: bool = False,
-          callbacks: Optional[List[Callable]] = None) -> Booster:
-    """reference: engine.py:36."""
+          callbacks: Optional[List[Callable]] = None,
+          checkpoint_dir: Optional[str] = None,
+          checkpoint_freq: int = 0,
+          resume: bool = False) -> Booster:
+    """reference: engine.py:36.
+
+    Fault tolerance (ft/checkpoint.py): with ``checkpoint_dir`` the run
+    writes crash-consistent checkpoints — every ``checkpoint_freq``
+    iterations when > 0, plus always one at the end — and
+    ``resume=True`` restores the newest valid checkpoint before
+    training, continuing BIT-identically to an uninterrupted run (same
+    trees, same training scores; see docs/RELIABILITY.md for what is
+    and is not covered). A killed run is re-invoked with the same
+    arguments plus ``resume=True``."""
     params = dict(params or {})
     fobj = _pop_callable_objective(params)
     # num_boost_round may come via params aliases
@@ -81,6 +93,22 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     jnp.asarray(tree.leaf_value[leaf].astype(_np.float32)))
         booster.inner._has_init_score = True  # don't re-boost from average
 
+    ckpt_state = None
+    if checkpoint_dir and resume:
+        from .ft import checkpoint as _ckpt
+        ckpt_state = _ckpt.load_latest(booster.inner, checkpoint_dir)
+        if ckpt_state is None:
+            log.info("resume=True but no valid checkpoint under %s; "
+                     "training from scratch" % checkpoint_dir)
+
+    def _maybe_checkpoint(force: bool = False) -> None:
+        if not checkpoint_dir:
+            return
+        it = booster.inner.iter
+        if force or (checkpoint_freq > 0 and it > 0
+                     and it % checkpoint_freq == 0):
+            booster.inner.save_checkpoint(checkpoint_dir)
+
     valid_sets = valid_sets or []
     valid_names = valid_names or []
     for i, vs in enumerate(valid_sets):
@@ -92,6 +120,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
         vs.params = dict(params, **(vs.params or {}))
         booster.add_valid(vs, name)
     eval_train_requested = any(vs is train_set for vs in valid_sets)
+
+    if ckpt_state is not None:
+        # the per-(valid set, metric) early-stop trackers can only be
+        # re-applied once the valid sets above have registered theirs
+        from .ft import checkpoint as _ckpt
+        _ckpt.restore_early_stop(booster.inner, ckpt_state)
+    resume_iter = booster.inner.iter if ckpt_state is not None else 0
 
     callbacks = list(callbacks or [])
     if cfg.early_stopping_round > 0 and not any(
@@ -118,7 +153,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
             log.info("tpu_batch_iterations=%d: evaluation/callbacks "
                      "run every %d iterations (batch boundaries)"
                      % (batch_n, batch_n))
-        i = 0
+        i = resume_iter
         degraded = False
         ran_batched = False
         rechecked = False
@@ -161,6 +196,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                         "boosting, or a multi-process learner)"
                         % batch_n)
                     degraded = True
+            _maybe_checkpoint()
             evaluation_result_list = []
             if valid_sets or eval_train_requested:
                 if eval_train_requested:
@@ -179,6 +215,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 for item in (e.best_score or []):
                     booster.best_score.setdefault(
                         item[0], {})[item[1]] = item[2]
+                _maybe_checkpoint(force=True)
                 return booster
             if finished:
                 break
@@ -189,11 +226,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
                              if valid_sets and i > 0 else []):
                     booster.best_score.setdefault(
                         item[0], {})[item[1]] = item[2]
+            _maybe_checkpoint(force=True)
             return booster
         # fall through to the plain per-iteration loop from iteration i
         start_i = i
     else:
-        start_i = 0
+        start_i = resume_iter
         if batch_n > 1:
             log.warning("tpu_batch_iterations=%d ignored: a custom "
                         "objective needs per-iteration gradients"
@@ -207,6 +245,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 begin_iteration=0, end_iteration=num_boost_round,
                 evaluation_result_list=None))
         finished = booster.update(fobj=fobj)
+        _maybe_checkpoint()
         evaluation_result_list = []
         if valid_sets or eval_train_requested:
             if eval_train_requested:
@@ -229,6 +268,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster.best_iteration = booster.current_iteration
         for item in evaluation_result_list if (valid_sets) else []:
             booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+    _maybe_checkpoint(force=True)
     return booster
 
 

@@ -1,0 +1,24 @@
+"""Fault-tolerant training plane.
+
+A production run measured in hours must be restartable by construction
+(the premise of XGBoost's scalable-GPU external-memory design and the
+reference's ``snapshot_freq``): a SIGKILL, an OOM kill, or a preempted
+host must cost at most one checkpoint interval, never the run. This
+package owns the crash-consistency layer:
+
+- :mod:`checkpoint` — atomically-finalized checkpoint directories
+  capturing the FULL resume state (trees, iteration/early-stop
+  bookkeeping, every host+device RNG sequence position, the training
+  scores bit-exactly), wired into ``lgb.train(checkpoint_dir=,
+  checkpoint_freq=, resume=True)`` and
+  ``GBDT.save_checkpoint``/``load_checkpoint``.
+
+Its failure-path siblings live where their call sites are:
+``utils/retry.py`` (bounded seeded retry/backoff), ``obs/faults.py``
+(deterministic fault injection), and the degradation paths in
+``io/shards.py`` (ENOSPC spill fallback, shard hash verification,
+prefetcher failure propagation). docs/RELIABILITY.md is the contract.
+"""
+from . import checkpoint  # noqa: F401
+
+__all__ = ["checkpoint"]

@@ -50,17 +50,22 @@ query groups, init scores, and alignment to a reference dataset.
 """
 from __future__ import annotations
 
+import concurrent.futures
+import errno
 import json
 import os
 import time
-from typing import Callable, Iterable, List, Optional, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
 from ..config import Config
 from ..obs import events as obs_events
+from ..obs import faults
 from ..obs.registry import registry as obs
 from ..utils import log
+from ..utils.atomic import atomic_write, sha256_file
+from ..utils.retry import retry_call
 from .binning import BinMapper
 from .dataset import (BinnedDataset, Metadata, _resolve_categorical,
                       find_bin_for_feature, load_forced_bounds,
@@ -68,6 +73,19 @@ from .dataset import (BinnedDataset, Metadata, _resolve_categorical,
 
 # default rows per spilled shard when the caller does not size them
 DEFAULT_SHARD_ROWS = 1 << 18
+
+# ENOSPC mid-spill falls back to holding the REMAINING shards resident
+# in host RAM when they fit this budget (else: fatal, telemetry
+# flushed) — a long build survives a full disk at the cost of the
+# O(chunk) memory contract for the un-spilled tail
+_ENV_RESIDENT_BUDGET = "LIGHTGBM_TPU_SPILL_RESIDENT_BUDGET_MB"
+# upper bound on one blocking wait for a staged shard: a wedged device
+# runtime must become a fatal health event, not an indefinite hang
+_ENV_STAGE_TIMEOUT = "LIGHTGBM_TPU_STAGE_TIMEOUT_S"
+
+
+def _is_enospc(e: BaseException) -> bool:
+    return getattr(e, "errno", None) == errno.ENOSPC
 
 
 def _device_put(x):
@@ -207,6 +225,13 @@ class ShardedBinnedDataset:
         self.shard_offsets: List[int] = []
         self.bins_dtype = np.uint8
         self.has_weights = False
+        # ENOSPC degradation: shards the spill could not write stay
+        # host-resident here and shard_bins_host serves them directly
+        self._resident_shards: Dict[int, np.ndarray] = {}
+        # manifest file table (name -> {sha256, bytes}) checked on
+        # every reopen: size per open, full content hash on the first
+        self._file_meta: Dict[str, dict] = {}
+        self._verified_shards: set = set()
 
     # ------------------------------------------------------------------
     @classmethod
@@ -295,19 +320,91 @@ class ShardedBinnedDataset:
         any_label = False
         any_weight = False
 
+        try:
+            resident_budget_mb = float(os.environ.get(
+                _ENV_RESIDENT_BUDGET, 512))
+        except ValueError:
+            resident_budget_mb = 512.0
+        degraded = False
+
+        def _cleanup_partial(k: int) -> None:
+            for p in (self._bins_path(k), self._label_path(k),
+                      self._weight_path(k)):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
         def flush():
-            nonlocal fill, shard_no
+            nonlocal fill, shard_no, degraded
             if fill == 0:
                 return
-            np.save(self._bins_path(shard_no), buf[:fill])
+            if not degraded:
+                def _write():
+                    faults.check("spill_write", shard=shard_no)
+                    np.save(self._bins_path(shard_no), buf[:fill])
+                    if any_label:
+                        np.save(self._label_path(shard_no), lbuf[:fill])
+                    if any_weight:
+                        np.save(self._weight_path(shard_no),
+                                wbuf[:fill])
+                try:
+                    retry_call(_write, site="spill_write",
+                               no_retry=_is_enospc)
+                    for p in [self._bins_path(shard_no)] \
+                            + ([self._label_path(shard_no)]
+                               if any_label else []) \
+                            + ([self._weight_path(shard_no)]
+                               if any_weight else []):
+                        self._file_meta[os.path.basename(p)] = {
+                            "sha256": sha256_file(p),
+                            "bytes": os.path.getsize(p)}
+                except OSError as e:
+                    # a retried write may have left a truncated file —
+                    # never leave it next to the manifest
+                    _cleanup_partial(shard_no)
+                    if not _is_enospc(e):
+                        log.fatal("spilling shard %d under %s failed "
+                                  "after retries: %r"
+                                  % (shard_no, self.spill_dir, e))
+                    # ENOSPC: the disk will not get emptier — degrade
+                    # to resident shards when the un-spilled remainder
+                    # fits the budget, else die with telemetry flushed
+                    remaining = n - sum(self.shard_sizes)
+                    row_bytes = (max(F_used, 1) * buf.itemsize
+                                 + 4 * (int(any_label)
+                                        + int(any_weight)))
+                    est_mb = remaining * row_bytes / 2.0**20
+                    if est_mb > resident_budget_mb:
+                        log.fatal(
+                            "disk full spilling shard %d and the "
+                            "remaining ~%.0f MB exceed %s=%.0f; free "
+                            "space or raise the budget"
+                            % (shard_no, est_mb, _ENV_RESIDENT_BUDGET,
+                               resident_budget_mb))
+                    degraded = True
+                    obs.inc("ft/spill_degraded")
+                    msg = ("disk full (ENOSPC) spilling shard %d; "
+                           "keeping the remaining ~%.0f MB of shards "
+                           "resident in host RAM (budget %s=%.0f) — "
+                           "the O(chunk) construction-memory contract "
+                           "is suspended for this build"
+                           % (shard_no, est_mb, _ENV_RESIDENT_BUDGET,
+                              resident_budget_mb))
+                    obs_events.emit("perf_warning",
+                                    component="io.shards", message=msg)
+                    obs_events.flush()
+                    log.warning_always(msg)
+            if degraded:
+                self._resident_shards[shard_no] = buf[:fill].copy()
+                obs.inc("io/shards_resident")
+            else:
+                obs.inc("io/shards_spilled")
             if any_label:
-                np.save(self._label_path(shard_no), lbuf[:fill])
                 labels.append(lbuf[:fill].copy())
             if any_weight:
-                np.save(self._weight_path(shard_no), wbuf[:fill])
                 weights.append(wbuf[:fill].copy())
             self.shard_sizes.append(fill)
-            obs.inc("io/shards_spilled")
             shard_no += 1
             fill = 0
 
@@ -356,17 +453,30 @@ class ShardedBinnedDataset:
             self.metadata.set_label(np.concatenate(labels))
         if any_weight:
             self.metadata.set_weights(np.concatenate(weights))
-        with open(os.path.join(self.spill_dir, "manifest.json"),
-                  "w") as fh:
-            json.dump({
-                "num_data": n,
-                "num_features_used": F_used,
-                "num_total_features": self.num_total_features,
-                "shard_sizes": self.shard_sizes,
-                "bins_dtype": np.dtype(self.bins_dtype).name,
-                "has_label": any_label, "has_weight": any_weight,
-                "max_num_bin": self.max_num_bin,
-            }, fh)
+        manifest = {
+            "num_data": n,
+            "num_features_used": F_used,
+            "num_total_features": self.num_total_features,
+            "shard_sizes": self.shard_sizes,
+            "bins_dtype": np.dtype(self.bins_dtype).name,
+            "has_label": any_label, "has_weight": any_weight,
+            "max_num_bin": self.max_num_bin,
+            # per-file content hashes: a truncated or poisoned shard
+            # is rejected loudly by name at reopen, never trained on
+            "files": self._file_meta,
+            "resident_shards": sorted(self._resident_shards),
+        }
+        try:
+            atomic_write(os.path.join(self.spill_dir, "manifest.json"),
+                         json.dumps(manifest))
+        except OSError as e:
+            if not degraded:
+                log.fatal("writing spill manifest under %s failed: %r"
+                          % (self.spill_dir, e))
+            # the degraded (disk-full) build still works from memory;
+            # only the on-disk forensics record is lost
+            log.warning_always("spill manifest write failed on the "
+                               "degraded build: %r" % e)
         obs_events.emit(
             "dataset", num_data=n, num_features=self.num_features,
             num_total_features=self.num_total_features,
@@ -422,9 +532,48 @@ class ShardedBinnedDataset:
         return len(self.shard_sizes)
 
     def shard_bins_host(self, k: int) -> np.ndarray:
-        """Memory-mapped [n_k, F_used] bin matrix of shard ``k`` —
-        touching it faults pages in, it never loads the file whole."""
-        return np.load(self._bins_path(k), mmap_mode="r")
+        """[n_k, F_used] bin matrix of shard ``k``: host-resident when
+        the spill degraded on ENOSPC, else memory-mapped (touching it
+        faults pages in, it never loads the file whole). Every reopen
+        checks the file size against the manifest and the first open
+        additionally verifies the content hash — a truncated or
+        poisoned shard fails loudly by name instead of silently
+        corrupting the run; transient open errors retry with backoff
+        (utils/retry.py)."""
+        if k in self._resident_shards:
+            return self._resident_shards[k]
+        path = self._bins_path(k)
+        name = os.path.basename(path)
+        meta = self._file_meta.get(name)
+        if meta is not None:
+            try:
+                size = os.path.getsize(path)
+            except OSError as e:
+                log.fatal("shard %s under %s is unreadable: %r"
+                          % (name, self.spill_dir, e))
+            if size != int(meta["bytes"]):
+                log.fatal("shard %s is truncated: %d bytes on disk, "
+                          "manifest records %d"
+                          % (name, size, int(meta["bytes"])))
+            # full content hash once per shard (first open). The hash
+            # read costs one pass over bytes the first sweep is about
+            # to stage anyway (page-cache warm); very large runs that
+            # would rather skip it set LIGHTGBM_TPU_SHARD_VERIFY=0 —
+            # the per-open size check above always stays on
+            if k not in self._verified_shards \
+                    and os.environ.get("LIGHTGBM_TPU_SHARD_VERIFY",
+                                       "1") != "0":
+                if sha256_file(path) != meta["sha256"]:
+                    log.fatal("shard %s fails its manifest content "
+                              "hash (truncated or poisoned spill); "
+                              "rebuild the spill directory" % name)
+                self._verified_shards.add(k)
+
+        def _open():
+            faults.check("shard_open", shard=name)
+            return np.load(path, mmap_mode="r")
+
+        return retry_call(_open, site="shard_open")
 
     def assemble_bins(self) -> np.ndarray:
         """Concatenate every shard into one [N, F_used] host matrix.
@@ -490,7 +639,14 @@ class ShardPrefetcher:
         self.dataset = dataset
         self.pad_cols = int(pad_cols)
         self._resident = {} if dataset.num_shards <= 2 else None
-        import concurrent.futures
+        try:
+            t = float(os.environ.get(_ENV_STAGE_TIMEOUT, 600))
+        except ValueError:
+            t = 600.0
+        # <= 0 disables the bound (same convention as the dtrain
+        # collective timeout); a negative value must never become an
+        # instantly-expiring fut.result(timeout<0)
+        self._stage_timeout = t if t > 0 else None
         import weakref
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="shard-prefetch")
@@ -503,12 +659,43 @@ class ShardPrefetcher:
         with obs.scope("io::shard_stage"):
             ds = self.dataset
             n_k = ds.shard_sizes[k]
-            host = np.zeros((n_k + 1, self.pad_cols),
-                            dtype=ds.bins_dtype)
-            host[:n_k, :ds.num_features] = ds.shard_bins_host(k)
-            dev = _device_put(host)
+
+            def _stage():
+                faults.check("prefetch_device_put", shard=k)
+                host = np.zeros((n_k + 1, self.pad_cols),
+                                dtype=ds.bins_dtype)
+                host[:n_k, :ds.num_features] = ds.shard_bins_host(k)
+                return _device_put(host)
+
+            # transient staging failures (a busy runtime, an I/O
+            # hiccup re-reading the memmap) retry with seeded backoff;
+            # exhaustion re-raises and sweep() turns the worker's
+            # exception into a fatal on the CONSUMER thread
+            dev = retry_call(_stage, site="prefetch_device_put",
+                             retry_on=(OSError, RuntimeError))
             obs.inc("io/shards_staged")
             return dev
+
+    def _await(self, fut, k: int):
+        """Blocking wait for a staged shard, bounded and loud: a worker
+        exception re-raises HERE (the consuming thread) as a fatal with
+        telemetry flushed, and a wedged staging hop becomes a fatal
+        ``health`` event after ``LIGHTGBM_TPU_STAGE_TIMEOUT_S`` instead
+        of an indefinite hang."""
+        try:
+            return fut.result(timeout=self._stage_timeout)
+        except concurrent.futures.TimeoutError:
+            obs_events.emit("health", rule="prefetch_hang",
+                            severity="fatal", shard=k,
+                            timeout_s=self._stage_timeout,
+                            detail="shard staging did not complete")
+            obs_events.flush()
+            log.fatal("staging shard %d did not complete within %.0f s "
+                      "(%s); the prefetch worker is wedged"
+                      % (k, self._stage_timeout, _ENV_STAGE_TIMEOUT))
+        except Exception as e:
+            log.fatal("staging shard %d failed after retries: %r"
+                      % (k, e))
 
     def _submit(self, k: int):
         if self._resident is not None and k in self._resident:
@@ -530,7 +717,7 @@ class ShardPrefetcher:
                 if hasattr(fut, "result"):
                     t0 = time.perf_counter()
                     stalled = not fut.done()
-                    arr = fut.result()
+                    arr = self._await(fut, k)
                     if stalled:
                         obs.inc("io/prefetch_stall_ms", max(int(
                             (time.perf_counter() - t0) * 1000), 1))

@@ -395,6 +395,18 @@ class Tree:
         t.left_child[:ni] = farr("left_child", ni, np.int32)
         t.right_child[:ni] = farr("right_child", ni, np.int32)
         t.leaf_value[:nl] = farr("leaf_value", nl)
+        # leaf_depth is a train-time field the text format does not
+        # carry; rebuild it from the structure — device traversal trip
+        # counts (ops/predict.py build_device_tree) and depth reporting
+        # on resumed/loaded trees read it
+        stack = [(0, 0)]
+        while stack:
+            idx, d = stack.pop()
+            if idx < 0:
+                t.leaf_depth[~idx] = d
+            else:
+                stack.append((int(t.left_child[idx]), d + 1))
+                stack.append((int(t.right_child[idx]), d + 1))
         if "leaf_weight" in kv:
             t.leaf_weight[:nl] = farr("leaf_weight", nl)
         if "leaf_count" in kv:
@@ -574,3 +586,29 @@ def _sane(v: float) -> float:
     if not np.isfinite(v):
         return 0.0
     return float(v)
+
+
+def parse_tree_blocks(s: str) -> List["Tree"]:
+    """Parse the ``Tree=<i>`` ... ``end of trees`` section of a v3
+    model text into Tree objects — THE tree-framing parser, shared by
+    ``GBDT.load_model_from_string`` and checkpoint resume
+    (ft/checkpoint.py) so the block grammar cannot drift between the
+    two loaders. Lines before the first ``Tree=`` are ignored, so the
+    full model text (or just its tree section) both work."""
+    models: List[Tree] = []
+    cur: List[str] = []
+    in_tree = False
+    for line in s.splitlines():
+        if line.startswith("Tree="):
+            if cur:
+                models.append(Tree.from_string("\n".join(cur)))
+            cur = []
+            in_tree = True
+        elif line.strip() == "end of trees":
+            if cur:
+                models.append(Tree.from_string("\n".join(cur)))
+            cur = []
+            in_tree = False
+        elif in_tree:
+            cur.append(line)
+    return models

@@ -35,7 +35,7 @@ See docs/OBSERVABILITY.md for the event schema and trace format.
 from __future__ import annotations
 
 from . import compile as compile_tracking  # noqa: F401
-from . import events, health  # noqa: F401
+from . import events, faults, health  # noqa: F401
 from .registry import MetricsRegistry, StageTimer, registry  # noqa: F401
 from . import trace  # noqa: F401  (installs the span hooks/taps)
 from . import export  # noqa: F401  (OpenMetrics snapshots + /metrics)

@@ -40,6 +40,7 @@ from typing import Dict, Optional, Tuple
 
 from . import events as _events
 from .registry import registry
+from ..utils import log
 
 _ENV_PATH = "LIGHTGBM_TPU_METRICS"
 _ENV_INTERVAL = "LIGHTGBM_TPU_METRICS_INTERVAL"
@@ -202,15 +203,27 @@ def metric_value(parsed: Dict[Sample, float], name: str,
 
 
 def dump_metrics(path: str, reg=registry) -> None:
-    """One-shot atomic snapshot dump. Never raises."""
+    """One-shot atomic snapshot dump. Never raises: transient write
+    failures retry with bounded backoff (utils/retry.py), and a dump
+    that still fails is SKIPPED with a counter + warning (the next
+    tick dumps again) — degradation, never a crash or a torn file."""
     try:
         text = render_openmetrics(reg)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(text)
-        os.replace(tmp, path)
-    except Exception:
-        pass
+
+        def _write():
+            from . import faults
+            faults.check("metrics_dump", path=path)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(text)
+            os.replace(tmp, path)
+
+        from ..utils.retry import retry_call
+        retry_call(_write, site="metrics_dump", reg=reg)
+    except Exception as e:
+        reg.inc("ft/metrics_dump_failed")
+        log.warning("metrics snapshot dump to %s failed: %r"
+                    % (path, e))
 
 
 # ----------------------------------------------------------------------

@@ -131,11 +131,19 @@ def default_rules() -> List[WatchRule]:
       time between snapshots) at or above this = a starving loader —
       on a day-long out-of-core run the device is idle that fraction
       of the time waiting for shard bytes;
-    - backend fallback and trace drops fire on ANY new occurrence.
+    - ``LIGHTGBM_TPU_WATCH_RETRY_STORM`` (default 16): total new
+      I/O retries (``ft/retries``) plus injected faults per snapshot
+      window at or above this = ``fault_storm`` — the run is limping
+      on its retry layer (a flaky disk/runtime), act before the
+      retries start exhausting;
+    - backend fallback, trace drops, and exhausted retries
+      (``retry_exhausted`` — some I/O site gave up after its bounded
+      attempts, utils/retry.py) fire on ANY new occurrence.
     """
     retrace_thr = _env_float("LIGHTGBM_TPU_WATCH_RETRACE_SPIKE", 8)
     queue_thr = _env_float("LIGHTGBM_TPU_WATCH_QUEUE_DEPTH", 1024)
     stall_thr = _env_float("LIGHTGBM_TPU_WATCH_PREFETCH_STALL", 0.25)
+    storm_thr = _env_float("LIGHTGBM_TPU_WATCH_RETRY_STORM", 16)
     # below this much new stall time the share is noise, not starvation
     kMinStallMs = 50.0
 
@@ -202,11 +210,41 @@ def default_rules() -> List[WatchRule]:
                               % (delta_ms, window)}
         return None
 
+    def retry_exhausted(snap, state):
+        # any I/O site that gave up after its bounded attempts is a
+        # breach on its own — whatever failure followed (fatal, dropped
+        # segment, skipped dump) already happened
+        delta = _counter_delta(
+            snap, state, frozenset(("ft/retry_exhausted",)), "prev",
+            first_is_baseline=False)
+        if delta > 0:
+            return {"value": delta, "threshold": 1,
+                    "detail": "an I/O retry site gave up after its "
+                              "bounded attempts"}
+        return None
+
+    def fault_storm(snap, state):
+        # rate rule (retries + injected faults per window): the first
+        # snapshot arms the baseline like retrace_spike — retries that
+        # happened before watching started are history, not a storm
+        delta = _counter_delta(
+            snap, state,
+            frozenset(("ft/retries", "ft/faults_injected")), "prev",
+            first_is_baseline=True)
+        if delta >= storm_thr:
+            return {"value": delta, "threshold": storm_thr,
+                    "detail": "%d I/O retries/injected faults in one "
+                              "snapshot interval (run is limping on "
+                              "the retry layer)" % delta}
+        return None
+
     return [WatchRule("retrace_spike", retrace_spike),
             WatchRule("backend_fallback", backend_fallback),
             WatchRule("queue_saturation", queue_saturation),
             WatchRule("trace_drops", trace_drops),
-            WatchRule("prefetch_stall", prefetch_stall)]
+            WatchRule("prefetch_stall", prefetch_stall),
+            WatchRule("retry_exhausted", retry_exhausted),
+            WatchRule("fault_storm", fault_storm)]
 
 
 class Watchdog:

@@ -61,6 +61,7 @@ import time
 from typing import Dict, List, Optional
 
 from . import events as _events
+from . import faults
 from .registry import install_trace_hooks as _install_trace_hooks
 from .registry import registry
 
@@ -434,10 +435,27 @@ class _Spool:
         body = ('{"traceEvents":[' + ",".join(meta + self._lines)
                 + '],"displayTimeUnit":"ms","otherData":'
                 + json.dumps(other) + "}")
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(body)
-        os.replace(tmp, path)
+
+        def _write():
+            faults.check("trace_finalize", segment=name)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(body)
+            os.replace(tmp, path)
+
+        # telemetry must never take training down: a segment whose
+        # finalize fails even after the bounded retries is DROPPED
+        # (counted like a backlog overflow) and the spool stays alive
+        from ..utils.retry import retry_call
+        try:
+            retry_call(_write, site="trace_finalize")
+        except Exception:
+            n_drop = len(self._lines)
+            self.dropped += n_drop
+            registry.inc("trace/dropped_events", n_drop)
+            self._lines = []
+            self._bytes = 0
+            return
         self._seq += 1
         self._lines = []
         self._bytes = 0

@@ -20,6 +20,7 @@ Usage (per process, after ``jax.distributed.initialize``)::
 """
 from __future__ import annotations
 
+import os
 from typing import Dict, Optional, Sequence
 
 import numpy as np
@@ -40,15 +41,82 @@ from .distributed import (DistributedDataParallelLearner,
                           distributed_binned_dataset, global_mesh)
 
 
+_ENV_COLLECTIVE_TIMEOUT = "LIGHTGBM_TPU_DTRAIN_TIMEOUT_S"
+kDefaultCollectiveTimeoutS = 300.0
+
+
+def _collective_timeout() -> float:
+    """Seconds one cross-process collective may block (<= 0 disables
+    the bound)."""
+    try:
+        return float(os.environ.get(_ENV_COLLECTIVE_TIMEOUT,
+                                    kDefaultCollectiveTimeoutS))
+    except ValueError:
+        return kDefaultCollectiveTimeoutS
+
+
+def run_collective(fn, what: str = "allreduce",
+                   timeout: Optional[float] = None):
+    """Run a blocking cross-process collective with peer-death
+    detection: the call executes on a watcher-owned thread and a peer
+    that never shows up (a dead/preempted rank would otherwise block
+    this rank FOREVER — the socket-allreduce failure mode of the
+    reference's network stack) turns into a fatal ``health`` event
+    (flushed) + ``log.fatal`` after the timeout
+    (``LIGHTGBM_TPU_DTRAIN_TIMEOUT_S``, default 300 s; <= 0 runs
+    unbounded). The abandoned worker thread is daemonized — the
+    process is going down anyway, loudly instead of silently."""
+    if timeout is None:
+        timeout = _collective_timeout()
+    if timeout <= 0:
+        return fn()
+    import threading
+    out: list = []
+    err: list = []
+
+    def _run():
+        try:
+            out.append(fn())
+        except BaseException as e:  # surfaced on the caller thread
+            err.append(e)
+
+    t = threading.Thread(target=_run, daemon=True,
+                         name="dtrain-collective")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        try:
+            rank = int(jax.process_index())
+        except Exception:
+            rank = -1
+        obs.inc("health/dtrain_peer_timeout")
+        obs_events.emit("health", rule="dtrain_peer_timeout",
+                        severity="fatal", what=what, rank=rank,
+                        value=timeout, threshold=timeout,
+                        detail="collective %r did not complete; a peer "
+                               "rank is likely dead" % what)
+        obs_events.flush()
+        log.fatal("distributed collective %r did not complete within "
+                  "%.0f s (%s) — a peer rank is likely dead or "
+                  "partitioned; aborting this rank instead of hanging"
+                  % (what, timeout, _ENV_COLLECTIVE_TIMEOUT))
+    if err:
+        raise err[0]
+    return out[0]
+
+
 def _allreduce_sum(vals: Sequence[float]) -> np.ndarray:
     """Scalar sums across processes (reference:
-    Network::GlobalSyncUpBySum, include/LightGBM/network.h:189)."""
+    Network::GlobalSyncUpBySum, include/LightGBM/network.h:189),
+    bounded by :func:`run_collective`."""
     from jax.experimental import multihost_utils
     obs.inc("dtrain/allreduce_sum")
     arr = np.asarray(vals, dtype=np.float64).reshape(1, -1)
     # float64 survives as two int32 words (x64 may be disabled)
     bits = np.ascontiguousarray(arr).view(np.int32)
-    gathered = np.asarray(multihost_utils.process_allgather(bits))
+    gathered = np.asarray(run_collective(
+        lambda: multihost_utils.process_allgather(bits),
+        what="allreduce_sum"))
     return np.ascontiguousarray(gathered).view(np.float64) \
         .reshape(jax.process_count(), -1).sum(axis=0)
 
@@ -116,8 +184,10 @@ def train(params: Dict, local_X: np.ndarray, local_y: np.ndarray,
         present = set(np.unique(local_y.astype(np.int64)))
         mask = [1.0 if k in present else 0.0 for k in expected]
         from jax.experimental import multihost_utils
-        all_masks = np.asarray(multihost_utils.process_allgather(
-            np.asarray(mask, dtype=np.float32).reshape(1, -1)))
+        mask_arr = np.asarray(mask, dtype=np.float32).reshape(1, -1)
+        all_masks = np.asarray(run_collective(
+            lambda: multihost_utils.process_allgather(mask_arr),
+            what="class_coverage_allgather"))
         all_masks = all_masks.reshape(jax.process_count(), -1)
         bad = {r: [expected[k] for k in range(len(expected))
                    if all_masks[r, k] == 0.0]

@@ -31,6 +31,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..obs import events as obs_events
+from ..obs import faults as obs_faults
 from ..obs import health as obs_health
 from ..obs.registry import registry as obs
 from ..utils import log
@@ -73,6 +74,10 @@ class ModelRegistry:
 
     def publish(self, name: str, forest: StackedForest,
                 source: str = "direct") -> int:
+        # fail-closed swap: an error here (including an injected one)
+        # propagates to the publisher BEFORE any mutation, so the
+        # previously published version keeps serving untouched
+        obs_faults.check("registry_swap", name=name)
         with self._lock:
             version = (self._models[name][0] + 1
                        if name in self._models else 1)

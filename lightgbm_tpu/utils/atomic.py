@@ -1,0 +1,73 @@
+"""Crash-consistent file writes: tmp + fsync + rename, in one place.
+
+Every artifact this package persists — model text, checkpoints, trace
+segments, metric snapshots — must be either absent or complete on disk
+after a crash at ANY instruction. The discipline is always the same
+(write to a same-directory temp name, fsync, ``os.replace`` over the
+final name), but before this module each writer carried its own copy
+and the model-text path (``GBDT.save_model`` + the ``snapshot_freq``
+snapshots) had none at all: a SIGKILL mid-``f.write`` left a truncated
+model file that parses as a shorter model or not at all. This is THE
+shared writer; new persistence code should not open(path, "w") a final
+name directly.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Union
+
+
+def sha256_file(path: str) -> str:
+    """Streamed sha256 of a file — the content-hash half of the
+    manifest discipline (shard spills, checkpoints): an artifact that
+    does not hash to its manifest entry is rejected by name instead of
+    trained on."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def fsync_dir(dirpath: str) -> None:
+    """Flush a directory entry (the rename itself) to disk;
+    best-effort — not every filesystem supports fsync on a dir fd."""
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, data: Union[str, bytes],
+                 durable: bool = True) -> None:
+    """Write ``data`` so ``path`` is either its previous content or the
+    complete new content — never a truncated mix. The temp file lives in
+    the target's directory (rename is only atomic within a filesystem)
+    and is removed on any failure. ``durable=True`` additionally fsyncs
+    the file (and, best-effort, the directory entry) so the rename
+    survives power loss, not just process death."""
+    path = str(path)
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    mode = "wb" if isinstance(data, bytes) else "w"
+    try:
+        with open(tmp, mode) as f:
+            f.write(data)
+            if durable:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if durable:
+        fsync_dir(os.path.dirname(os.path.abspath(path)))

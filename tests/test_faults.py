@@ -1,0 +1,409 @@
+"""Fault-injection harness (obs/faults.py), retry/degradation layer
+(utils/retry.py + the wired sites), and the dtrain collective timeout.
+
+The contract under test, per injection site: an injected fault is
+either RETRIED to success, DEGRADED with a structured event, or FATAL
+with flushed telemetry — never a hang (every test bounds wall time via
+tiny retry backoff) and never a silently corrupt artifact."""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.boosting import create_boosting
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.io.shards import ShardedBinnedDataset
+from lightgbm_tpu.obs import events, faults
+from lightgbm_tpu.obs.faults import InjectedFault
+from lightgbm_tpu.obs.registry import registry
+from lightgbm_tpu.utils.log import LightGBMError
+from lightgbm_tpu.utils.retry import retry_call
+
+BASE = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+        "bin_construct_sample_cnt": 800, "min_data_in_leaf": 5}
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries_and_clean_faults(monkeypatch):
+    monkeypatch.setenv("LIGHTGBM_TPU_RETRY_BASE_MS", "1")
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _data(n=800, f=6, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] - X[:, 1] + 0.3 * rng.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+def _sharded(tmp_path, params=None, tag="sp"):
+    X, y = _data()
+
+    def src():
+        for lo in range(0, 800, 250):
+            yield X[lo:lo + 250], y[lo:lo + 250].astype(np.float32)
+
+    return ShardedBinnedDataset.from_chunk_source(
+        src, Config.from_params(dict(params or BASE)),
+        str(tmp_path / tag), shard_rows=300, total_rows=800)
+
+
+def _collect(event_name, seen):
+    events.register_event_callback(
+        lambda rec: seen.append(rec) if rec["event"] == event_name
+        else None)
+
+
+# ---------------------------------------------------------------------------
+# spec parsing + scheduling semantics
+# ---------------------------------------------------------------------------
+
+class TestSpecs:
+    def test_modes_fire_deterministically(self):
+        faults.configure("s1:nth:3;s2:once;s3:always")
+        fired = []
+        for i in range(5):
+            for site in ("s1", "s2", "s3"):
+                try:
+                    faults.check(site)
+                except InjectedFault:
+                    fired.append((site, i))
+        assert [f for f in fired if f[0] == "s1"] == [("s1", 2)]
+        assert [f for f in fired if f[0] == "s2"] == [("s2", 0)]
+        assert [f for f in fired if f[0] == "s3"] == [
+            ("s3", i) for i in range(5)]
+
+    def test_prob_mode_is_seeded(self):
+        def pattern():
+            out = []
+            faults.configure("p:prob:0.5::42")
+            for i in range(32):
+                try:
+                    faults.check("p")
+                    out.append(0)
+                except InjectedFault:
+                    out.append(1)
+            return out
+        a, b = pattern(), pattern()
+        assert a == b and 0 < sum(a) < 32
+
+    def test_errno_name_rides_the_exception(self):
+        import errno
+        faults.configure("w:once:0:ENOSPC")
+        with pytest.raises(InjectedFault) as ei:
+            faults.check("w")
+        assert ei.value.errno == errno.ENOSPC
+
+    def test_malformed_specs_rejected(self):
+        for bad in ("justasite", "s:unknownmode", "s:nth",
+                    "s:nth:0", "s:once:0:NOSUCHERRNO"):
+            with pytest.raises(ValueError):
+                faults.parse_spec(bad)
+
+    def test_env_spec_late_assignment(self, monkeypatch):
+        monkeypatch.setenv("LIGHTGBM_TPU_FAULTS", "envsite:once")
+        with pytest.raises(InjectedFault):
+            faults.check("envsite")
+        faults.check("envsite")  # once: second call passes
+
+    def test_fault_emits_flushed_event_and_counter(self):
+        seen = []
+        _collect("fault_injected", seen)
+        before = registry.count("ft/faults_injected")
+        faults.configure("x:once")
+        try:
+            with pytest.raises(InjectedFault):
+                faults.check("x", shard=7)
+        finally:
+            events.register_event_callback(None)
+        assert registry.count("ft/faults_injected") == before + 1
+        assert seen and seen[0]["site"] == "x" \
+            and seen[0]["shard"] == "7"
+
+
+# ---------------------------------------------------------------------------
+# retry_call semantics
+# ---------------------------------------------------------------------------
+
+class TestRetryCall:
+    def test_retries_then_succeeds_and_counts(self):
+        calls = []
+        before = registry.count("ft/retries")
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+        assert retry_call(flaky, site="t1", attempts=5) == "ok"
+        assert registry.count("ft/retries") == before + 2
+        assert registry.count("ft/retries/t1") >= 2
+
+    def test_exhaustion_emits_flushed_event_and_reraises(self):
+        seen = []
+        _collect("retry_exhausted", seen)
+        before = registry.count("ft/retry_exhausted")
+        try:
+            with pytest.raises(OSError):
+                retry_call(lambda: (_ for _ in ()).throw(OSError("x")),
+                           site="t2", attempts=2)
+        finally:
+            events.register_event_callback(None)
+        assert registry.count("ft/retry_exhausted") == before + 1
+        assert seen and seen[0]["site"] == "t2"
+
+    def test_no_retry_predicate_vetoes(self):
+        calls = []
+
+        def fail():
+            calls.append(1)
+            raise OSError("fatal-class")
+        with pytest.raises(OSError):
+            retry_call(fail, site="t3", attempts=5,
+                       no_retry=lambda e: True)
+        assert len(calls) == 1  # no second attempt, no backoff
+
+
+# ---------------------------------------------------------------------------
+# site wiring: retried / degraded / fatal, never a hang
+# ---------------------------------------------------------------------------
+
+class TestPrefetcherFaults:
+    def test_transient_staging_fault_is_retried(self, tmp_path):
+        faults.configure("prefetch_device_put:nth:2")
+        ds = _sharded(tmp_path)
+        b = create_boosting(
+            Config.from_params(dict(BASE, num_iterations=2)), ds)
+        r0 = registry.count("ft/retries/prefetch_device_put")
+        for _ in range(2):
+            b.train_one_iter()
+        assert registry.count("ft/retries/prefetch_device_put") > r0
+        assert b.iter == 2  # recovered, training completed
+
+    def test_persistent_staging_fault_is_bounded_fatal(self, tmp_path,
+                                                       monkeypatch):
+        monkeypatch.setenv("LIGHTGBM_TPU_RETRY_ATTEMPTS", "2")
+        faults.configure("prefetch_device_put:always")
+        ds = _sharded(tmp_path)
+        b = create_boosting(
+            Config.from_params(dict(BASE, num_iterations=2)), ds)
+        t0 = time.perf_counter()
+        with pytest.raises(LightGBMError, match="staging shard"):
+            b.train_one_iter()
+        # the worker's exception PROPAGATED to the consumer thread —
+        # no hang, and well inside any staging timeout
+        assert time.perf_counter() - t0 < 30
+
+
+class TestSpillFaults:
+    def test_enospc_degrades_to_resident_bit_identical(self, tmp_path,
+                                                       monkeypatch):
+        """Disk full mid-spill: the remaining shards stay host-resident
+        (perf_warning event), and the degraded dataset still trains
+        BIT-identically to the in-memory path — degradation must never
+        change results."""
+        seen = []
+        _collect("perf_warning", seen)
+        faults.configure("spill_write:nth:2:ENOSPC")
+        try:
+            ds = _sharded(tmp_path)
+        finally:
+            events.register_event_callback(None)
+        assert sorted(ds._resident_shards) == [1, 2]
+        assert ds.shard_sizes == [300, 300, 200]
+        assert any("ENOSPC" in r["message"] for r in seen)
+        assert registry.count("ft/spill_degraded") >= 1
+        faults.reset()
+        X, y = _data()
+        b_sh = create_boosting(
+            Config.from_params(dict(BASE, num_iterations=3)), ds)
+        for _ in range(3):
+            b_sh.train_one_iter()
+        ds_mem = BinnedDataset.from_matrix(
+            X, Config.from_params(dict(BASE)), label=y)
+        b_mem = create_boosting(
+            Config.from_params(dict(BASE, num_iterations=3)), ds_mem)
+        for _ in range(3):
+            b_mem.train_one_iter()
+        assert b_sh.save_model_to_string() \
+            == b_mem.save_model_to_string()
+
+    def test_enospc_over_budget_is_fatal_with_flushed_log(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("LIGHTGBM_TPU_SPILL_RESIDENT_BUDGET_MB", "0")
+        log_path = str(tmp_path / "events.jsonl")
+        monkeypatch.setenv("LIGHTGBM_TPU_EVENT_LOG", log_path)
+        faults.configure("spill_write:nth:1:ENOSPC")
+        with pytest.raises(LightGBMError, match="disk full"):
+            _sharded(tmp_path)
+        recs = events.read_jsonl(log_path)
+        names = [r["event"] for r in recs]
+        # telemetry flushed BEFORE the raise: the fatal is on disk
+        assert "fault_injected" in names and "log_fatal" in names
+
+    def test_transient_spill_error_is_retried(self, tmp_path):
+        faults.configure("spill_write:nth:1")  # default EIO: transient
+        ds = _sharded(tmp_path)
+        assert ds._resident_shards == {}  # retried, all spilled
+        assert registry.count("ft/retries/spill_write") >= 1
+
+
+class TestShardOpenFaults:
+    def test_poisoned_shard_rejected_by_name(self, tmp_path):
+        ds = _sharded(tmp_path)
+        p = ds._bins_path(1)
+        data = bytearray(open(p, "rb").read())
+        data[-10] ^= 0xFF          # same size: only the hash can tell
+        open(p, "wb").write(bytes(data))
+        with pytest.raises(LightGBMError,
+                           match="shard_0001.*content hash"):
+            ds.shard_bins_host(1)
+
+    def test_truncated_shard_rejected_every_open(self, tmp_path):
+        ds = _sharded(tmp_path)
+        ds.shard_bins_host(1)      # first open: hash verified + cached
+        p = ds._bins_path(1)
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) - 32)
+        with pytest.raises(LightGBMError, match="truncated"):
+            ds.shard_bins_host(1)  # size check runs on EVERY reopen
+
+    def test_transient_open_fault_is_retried(self, tmp_path):
+        ds = _sharded(tmp_path)
+        faults.configure("shard_open:nth:1")
+        out = ds.shard_bins_host(0)
+        assert out.shape == (300, ds.num_features)
+        assert registry.count("ft/retries/shard_open") >= 1
+
+
+class TestTelemetryFaults:
+    def test_trace_finalize_degrades_to_counted_drop(self, tmp_path,
+                                                     monkeypatch):
+        monkeypatch.setenv("LIGHTGBM_TPU_RETRY_ATTEMPTS", "2")
+        from lightgbm_tpu.obs import trace
+        d = str(tmp_path / "spool")
+        os.makedirs(d)
+        trace.configure_stream(d, segment_bytes=2000)
+        faults.configure("trace_finalize:always")
+        try:
+            d0 = registry.count("trace/dropped_events")
+            for _ in range(2000):
+                tok = trace._Hooks.begin("stage_x")
+                trace._Hooks.end(tok)
+            trace.flush()          # never raises; spool stays alive
+            assert registry.count("trace/dropped_events") > d0
+            assert [f for f in os.listdir(d) if f.endswith(".json")] \
+                == []
+        finally:
+            faults.reset()
+            trace.configure_stream(None)
+
+    def test_metrics_dump_degrades_and_recovers(self, tmp_path,
+                                                monkeypatch):
+        monkeypatch.setenv("LIGHTGBM_TPU_RETRY_ATTEMPTS", "2")
+        from lightgbm_tpu.obs import export
+        p = str(tmp_path / "metrics.txt")
+        faults.configure("metrics_dump:always")
+        c0 = registry.count("ft/metrics_dump_failed")
+        export.dump_metrics(p)     # contract: never raises
+        assert not os.path.exists(p)
+        assert registry.count("ft/metrics_dump_failed") == c0 + 1
+        faults.reset()
+        export.dump_metrics(p)     # next tick recovers
+        assert os.path.exists(p)
+
+    def test_registry_swap_fails_closed(self):
+        from lightgbm_tpu.serve.server import ModelRegistry
+        X, y = _data(300)
+        b = create_boosting(
+            Config.from_params(dict(BASE, num_iterations=2)),
+            BinnedDataset.from_matrix(
+                X, Config.from_params(dict(BASE)), label=y))
+        b.train_one_iter()
+        reg = ModelRegistry()
+        reg.load(booster=b)
+        v1, forest1 = reg.get()
+        faults.configure("registry_swap:once")
+        with pytest.raises(InjectedFault):
+            reg.load(booster=b)
+        v, forest = reg.get()      # old version serves untouched
+        assert v == v1 and forest is forest1
+        assert reg.load(booster=b) == v1 + 1  # next swap succeeds
+
+
+class TestCheckpointFaults:
+    def test_finalize_fault_retried_to_success(self, tmp_path):
+        X, y = _data(400)
+        b = create_boosting(
+            Config.from_params(dict(BASE, num_iterations=2)),
+            BinnedDataset.from_matrix(
+                X, Config.from_params(dict(BASE)), label=y))
+        b.train_one_iter()
+        faults.configure("checkpoint_finalize:nth:1")
+        path = b.save_checkpoint(str(tmp_path / "ck"))
+        from lightgbm_tpu.ft import checkpoint as ckpt
+        ckpt.validate_dir(path)    # the retried write is complete
+        assert registry.count("ft/retries/checkpoint_finalize") >= 1
+
+    def test_persistent_finalize_fault_fatal_no_partial(self, tmp_path,
+                                                        monkeypatch):
+        monkeypatch.setenv("LIGHTGBM_TPU_RETRY_ATTEMPTS", "2")
+        X, y = _data(400)
+        b = create_boosting(
+            Config.from_params(dict(BASE, num_iterations=2)),
+            BinnedDataset.from_matrix(
+                X, Config.from_params(dict(BASE)), label=y))
+        b.train_one_iter()
+        faults.configure("checkpoint_finalize:always")
+        ckdir = tmp_path / "ck"
+        with pytest.raises(LightGBMError, match="checkpoint"):
+            b.save_checkpoint(str(ckdir))
+        # no finalized-looking directory, no lingering temp
+        assert [n for n in os.listdir(ckdir)
+                if n.startswith("ckpt-")] == []
+
+
+# ---------------------------------------------------------------------------
+# dtrain collective timeout (no real sockets / processes)
+# ---------------------------------------------------------------------------
+
+class TestDtrainTimeout:
+    def test_dead_peer_is_fatal_health_event(self):
+        from lightgbm_tpu.parallel.dtrain import run_collective
+        seen = []
+        _collect("health", seen)
+        t0 = time.perf_counter()
+        try:
+            with pytest.raises(LightGBMError, match="peer rank"):
+                run_collective(lambda: threading.Event().wait(),
+                               what="allreduce_sum", timeout=0.2)
+        finally:
+            events.register_event_callback(None)
+        assert 0.15 < time.perf_counter() - t0 < 10
+        assert seen and seen[0]["rule"] == "dtrain_peer_timeout" \
+            and seen[0]["severity"] == "fatal"
+        assert registry.count("health/dtrain_peer_timeout") >= 1
+
+    def test_completed_collective_passes_through(self):
+        from lightgbm_tpu.parallel.dtrain import run_collective
+        assert run_collective(lambda: 41 + 1, timeout=5.0) == 42
+
+    def test_worker_exception_reraises_on_caller(self):
+        from lightgbm_tpu.parallel.dtrain import run_collective
+
+        def boom():
+            raise ValueError("collective blew up")
+        with pytest.raises(ValueError, match="blew up"):
+            run_collective(boom, timeout=5.0)
+
+    def test_timeout_disabled_runs_inline(self, monkeypatch):
+        from lightgbm_tpu.parallel import dtrain
+        monkeypatch.setenv("LIGHTGBM_TPU_DTRAIN_TIMEOUT_S", "0")
+        assert dtrain._collective_timeout() == 0
+        assert dtrain.run_collective(lambda: "inline") == "inline"

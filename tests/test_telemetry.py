@@ -519,6 +519,44 @@ def test_watchdog_prefetch_stall_share():
     assert registry.count("health/prefetch_stall") == 2
 
 
+def test_watchdog_retry_exhausted_and_fault_storm():
+    """The fault-tolerance rules (utils/retry.py counters): any retry
+    give-up breaches ``retry_exhausted`` immediately; a windowed burst
+    of retries/injected faults past the threshold breaches
+    ``fault_storm`` — both once-per-breach with re-arm, like every
+    other rule."""
+    registry.reset()
+    seen = []
+    events.register_event_callback(
+        lambda rec: seen.append(rec) if rec["event"] == "health" else None)
+    wd = Watchdog(registry)
+    assert wd.evaluate() == []              # arms baselines
+
+    # retry_exhausted: event-like, any new give-up fires
+    registry.inc("ft/retry_exhausted")
+    assert [f["rule"] for f in wd.evaluate()] == ["retry_exhausted"]
+    assert wd.evaluate() == []              # once per breach
+    registry.inc("ft/retry_exhausted")      # a second give-up
+    assert [f["rule"] for f in wd.evaluate()] == ["retry_exhausted"]
+
+    # fault_storm: rate rule over ft/retries + ft/faults_injected
+    registry.inc("ft/retries", 10)
+    registry.inc("ft/faults_injected", 10)  # 20 >= default 16
+    assert [f["rule"] for f in wd.evaluate()] == ["fault_storm"]
+    assert wd.evaluate() == []              # storm passed: re-armed
+    registry.inc("ft/retries", 3)           # sub-threshold trickle
+    assert wd.evaluate() == []
+    registry.inc("ft/retries", 40)          # second storm
+    assert [f["rule"] for f in wd.evaluate()] == ["fault_storm"]
+
+    events.register_event_callback(None)
+    rules = [r["rule"] for r in seen]
+    assert rules.count("retry_exhausted") == 2
+    assert rules.count("fault_storm") == 2
+    assert registry.count("health/retry_exhausted") == 2
+    assert registry.count("health/fault_storm") == 2
+
+
 def test_watchdog_inline_tick_env(monkeypatch):
     """LIGHTGBM_TPU_WATCHDOG=1 routes per-iteration ticks through the
     default watchdog even without a metrics file exporter."""
