@@ -840,6 +840,193 @@ def run_chaos_bench() -> dict:
     }
 
 
+def run_serve_bench() -> dict:
+    """Serving stage (``python bench.py serve`` or BENCH_SERVE=1): the
+    resilient serving plane under real traffic, three segments —
+
+    1. **throughput**: producer threads push row blocks through an
+       unloaded PredictServer; ``serve_rows_per_sec`` (coalesced
+       dispatch throughput) and ``serve_p99_ms`` (queue + dispatch
+       tail) are the headline keys.
+    2. **overload**: the queue is re-bounded to a fraction of the
+       offered load (reject policy) and producers deliberately outrun
+       the worker — the segment ASSERTS sheds happen (typed
+       ``Overloaded`` failures, ``serve/shed_total`` counted) and that
+       EVERY Future resolves: nothing hangs, accepted answers match
+       the unloaded path. ``serve_shed_fraction`` reports the shed
+       share.
+    3. **canary**: a canary publish under an injected
+       ``serve_dispatch`` fault must auto-roll back while callers keep
+       being served, and a clean canary window must promote
+       (``serve_rollbacks``).
+
+    Exit is nonzero (``serve_ok`` false) if the overload segment sheds
+    nothing, any Future hangs, an accepted answer deviates, or the
+    rollback/promote contract breaks.
+
+    Env knobs: BENCH_SERVE_ROWS (40k model-training rows),
+    BENCH_SERVE_ITERS (12 trained iterations), BENCH_SERVE_BUDGET
+    (throughput seconds, default 8), BENCH_SERVE_THREADS (4).
+    """
+    import concurrent.futures as cf
+    import threading
+
+    import jax
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import faults
+    from lightgbm_tpu.obs import health as obs_health
+    from lightgbm_tpu.obs.registry import registry as obs_registry
+    from lightgbm_tpu.serve import (ModelRegistry, Overloaded,
+                                    PredictServer, StackedForest)
+
+    _enable_compile_cache()
+    platform = jax.devices()[0].platform
+    obs_registry.enable()
+    obs_health.record_backend(platform, source="bench_serve")
+
+    rows = int(os.environ.get("BENCH_SERVE_ROWS", 40_000))
+    iters = int(os.environ.get("BENCH_SERVE_ITERS", 12))
+    budget = float(os.environ.get("BENCH_SERVE_BUDGET", 8.0))
+    n_threads = int(os.environ.get("BENCH_SERVE_THREADS", 4))
+    n_feat = 28
+    X, y = make_higgs_like(rows, n_feat, seed=7)
+    _stage("serve_train_start", rows=rows, iters=iters)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "max_bin": 255, "verbosity": -1,
+                     "min_data_in_leaf": 20,
+                     "bin_construct_sample_cnt": 20_000},
+                    lgb.Dataset(X, label=y), num_boost_round=iters)
+    forest = StackedForest.from_gbdt(bst)
+    problems = []
+
+    # ---- segment 1: throughput + tail latency -----------------------
+    srv = PredictServer(forest, max_batch=512, max_wait_ms=2)
+    block = np.ascontiguousarray(X[:64], dtype=np.float32)
+    srv.predict(block, timeout=120)       # warm the bucket compiles
+    srv.predict(X[:512], timeout=120)
+    served_rows = [0] * n_threads
+    t_end = time.time() + budget
+
+    def pump(t):
+        while time.time() < t_end:
+            srv.predict(block, timeout=120)
+            served_rows[t] += block.shape[0]
+
+    t0 = time.time()
+    threads = [threading.Thread(target=pump, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    wall = time.time() - t0
+    rps = sum(served_rows) / max(wall, 1e-9)
+    p99 = srv.latency_percentiles()["p99"]
+    srv.stop()
+    _stage("serve_throughput", rows_per_sec=round(rps, 1),
+           p99_ms=round(p99, 3), threads=n_threads)
+
+    # ---- segment 2: overload (sheds must happen, nothing may hang) --
+    shed0 = obs_registry.count("serve/shed_total")
+    kCap = 256
+    srv = PredictServer(forest, max_batch=256, max_wait_ms=50,
+                        max_queue_rows=kCap, overflow="reject")
+    host_ref = np.asarray(bst.predict(X[:64], predict_on_device=False))
+    n_load_threads, per = 8, 300
+    futs = [[] for _ in range(n_load_threads)]
+
+    def flood(t):
+        for i in range(per):
+            idx = (t * per + i) % 64
+            futs[t].append((idx, srv.submit(X[idx])))
+
+    threads = [threading.Thread(target=flood, args=(t,))
+               for t in range(n_load_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    ok = shed = hung = wrong = 0
+    for t in range(n_load_threads):
+        for idx, fut in futs[t]:
+            try:
+                val = fut.result(timeout=120)
+                ok += 1
+                if val != host_ref[idx]:
+                    wrong += 1
+            except Overloaded:
+                shed += 1
+            except cf.TimeoutError:
+                hung += 1
+    srv.stop()
+    total = n_load_threads * per
+    shed_counted = obs_registry.count("serve/shed_total") - shed0
+    shed_fraction = shed / max(total, 1)
+    if shed == 0:
+        problems.append("overload segment shed nothing")
+    if hung:
+        problems.append("%d futures hung" % hung)
+    if wrong:
+        problems.append("%d accepted answers deviated" % wrong)
+    if shed_counted != shed:
+        problems.append("shed accounting mismatch (%d counted, %d "
+                        "observed)" % (shed_counted, shed))
+    _stage("serve_overload", submitted=total, served=ok, shed=shed,
+           shed_fraction=round(shed_fraction, 4), hung=hung,
+           max_queue_rows=kCap)
+
+    # ---- segment 3: canary rollback + promote -----------------------
+    rb0 = obs_registry.count("serve/rollbacks")
+    reg = ModelRegistry()
+    v1 = reg.load("m", booster=bst, num_iteration=max(iters // 2, 1))
+    srv = PredictServer(reg, name="m", max_batch=256, max_wait_ms=2)
+    srv.predict(X[:64], timeout=120)
+    reg.load("m", booster=bst, canary_batches=2)
+    faults.configure("serve_dispatch:nth:1")
+    try:
+        srv.predict(X[:64], timeout=120)   # rolls back, replays on v1
+    finally:
+        faults.reset()
+    rolled = (obs_registry.count("serve/rollbacks") - rb0 == 1
+              and reg.get("m")[0] == v1)
+    if not rolled:
+        problems.append("canary fault did not roll back")
+    v3 = reg.load("m", booster=bst, canary_batches=2)
+    srv.predict(X[:64], timeout=120)
+    srv.predict(X[64:128], timeout=120)
+    promoted = reg.get("m")[0] == v3
+    if not promoted:
+        problems.append("clean canary window did not promote")
+    srv.stop()
+    rollbacks = obs_registry.count("serve/rollbacks") - rb0
+    _stage("serve_canary", rollbacks=rollbacks, promoted=promoted)
+
+    serve_ok = not problems
+    _stage("serve_done", rows_per_sec=round(rps, 1),
+           p99_ms=round(p99, 3),
+           shed_fraction=round(shed_fraction, 4),
+           rollbacks=rollbacks, ok=serve_ok,
+           problems="; ".join(problems))
+    return {
+        "metric": "serve_rows_per_sec",
+        "value": round(rps, 1),
+        "unit": "rows/s on %s (%d threads; p99 %.2f ms; overload shed "
+                "%.0f%% of %d, 0 hung; canary rollbacks %d, promote "
+                "%s%s)"
+                % (platform, n_threads, p99, 100 * shed_fraction,
+                   total, rollbacks, promoted,
+                   "" if serve_ok else "; PROBLEMS: "
+                   + "; ".join(problems)),
+        "backend": platform,
+        "serve_rows_per_sec": round(rps, 1),
+        "serve_p99_ms": round(p99, 3),
+        "serve_shed_fraction": round(shed_fraction, 4),
+        "serve_rollbacks": rollbacks,
+        "serve_ok": bool(serve_ok),
+    }
+
+
 def run_bench(n_rows=None, n_iters=None, budget=None) -> dict:
     if n_rows is None:
         n_rows = int(os.environ.get("BENCH_ROWS", HIGGS_ROWS))
@@ -1181,6 +1368,30 @@ def main() -> None:
             sys.exit(1)
         print(json.dumps(result))
         if not result.get("chaos_bit_identical"):
+            sys.exit(1)
+        return
+    if (os.environ.get("BENCH_SERVE")
+            or (len(sys.argv) > 1 and sys.argv[1] == "serve")):
+        # serving stage: the overload/canary contracts are
+        # backend-agnostic; throughput is honest on CPU too (the
+        # stacked dispatch lowers to plain XLA gathers)
+        if os.environ.get("JAX_PLATFORMS") in (None, "") \
+                and not os.environ.get("PALLAS_AXON_POOL_IPS"):
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            result = run_serve_bench()
+        except Exception as e:
+            result = {"metric": "serve_rows_per_sec", "value": 0.0,
+                      "unit": "rows/s (FAILED: %s: %s)"
+                              % (type(e).__name__, str(e)[:300]),
+                      "serve_p99_ms": 0.0,
+                      "serve_shed_fraction": 0.0,
+                      "serve_rollbacks": 0,
+                      "serve_ok": False}
+            print(json.dumps(result))
+            sys.exit(1)
+        print(json.dumps(result))
+        if not result["serve_ok"]:
             sys.exit(1)
         return
     if (os.environ.get("BENCH_HIST")

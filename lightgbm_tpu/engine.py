@@ -142,6 +142,29 @@ def train(params: Dict[str, Any], train_set: Dataset,
     callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
+    # checkpointable engine-level callback state: the early_stopping
+    # closure exposes get_state/set_state, so a resumed run continues
+    # the SAME patience window (best score/iter) instead of re-arming
+    # it from the resume point. The provider rides on the booster — the
+    # checkpoint writer (ft/checkpoint.py save) snapshots it under
+    # state["engine"] at every checkpoint.
+    stateful_cbs = [cb for cb in callbacks_after
+                    if hasattr(cb, "get_state")
+                    and hasattr(cb, "set_state")]
+    if ckpt_state is not None and stateful_cbs:
+        saved = (ckpt_state.get("engine") or {}).get("early_stopping")
+        if saved:
+            for cb, st in zip(stateful_cbs, saved):
+                cb.set_state(st)
+
+    def _engine_state():
+        states = [cb.get_state() for cb in stateful_cbs]
+        if not any(s is not None for s in states):
+            return None
+        return {"early_stopping": states}
+
+    booster.inner._engine_state_provider = _engine_state
+
     # tpu_batch_iterations: run N iterations per device dispatch
     # (gbdt.py train_batch). Evaluation and callbacks then fire at
     # BATCH boundaries — early stopping still measures its patience in
@@ -196,7 +219,6 @@ def train(params: Dict[str, Any], train_set: Dataset,
                         "boosting, or a multi-process learner)"
                         % batch_n)
                     degraded = True
-            _maybe_checkpoint()
             evaluation_result_list = []
             if valid_sets or eval_train_requested:
                 if eval_train_requested:
@@ -217,6 +239,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
                         item[0], {})[item[1]] = item[2]
                 _maybe_checkpoint(force=True)
                 return booster
+            # checkpoint AFTER this boundary's eval + callbacks so the
+            # captured callback state (early_stopping patience) is
+            # exactly "everything through this iteration" — resume
+            # continues at the next one
+            _maybe_checkpoint()
             if finished:
                 break
         if not degraded:
@@ -245,7 +272,6 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 begin_iteration=0, end_iteration=num_boost_round,
                 evaluation_result_list=None))
         finished = booster.update(fobj=fobj)
-        _maybe_checkpoint()
         evaluation_result_list = []
         if valid_sets or eval_train_requested:
             if eval_train_requested:
@@ -262,6 +288,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
             for item in (e.best_score or []):
                 booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
             break
+        # checkpoint AFTER eval + callbacks: the captured callback
+        # state (early_stopping patience) then covers exactly the
+        # iterations the resumed run will not replay
+        _maybe_checkpoint()
         if finished:
             break
     if booster.best_iteration <= 0:

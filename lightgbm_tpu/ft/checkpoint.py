@@ -35,12 +35,17 @@ bits deviate beyond the f32 replay tolerance — corruption that slips
 past the hash check (or a dataset that is not the one trained on)
 still cannot resume silently.
 
+The engine-level ``early_stopping`` callback's closure state (best
+score/iter per metric — the patience counter is implicit in the
+absolute best_iter) is captured too, via the
+``gbdt._engine_state_provider`` hook ``lgb.train`` installs: resume
+continues the SAME patience window instead of re-arming it from the
+resume point.
+
 NOT captured (refused or documented in docs/RELIABILITY.md): CEGB's
-cross-tree device state, multi-process dtrain runs, the engine-level
-``early_stopping`` callback's closure state (patience re-accumulates
-from the resume point), and the dataset itself — the caller re-binns
-the same rows (deterministic mappers make the rebuilt dataset, sharded
-or resident, bit-identical).
+cross-tree device state, multi-process dtrain runs, and the dataset
+itself — the caller re-binns the same rows (deterministic mappers make
+the rebuilt dataset, sharded or resident, bit-identical).
 """
 from __future__ import annotations
 
@@ -286,6 +291,20 @@ def save(gbdt, directory: str, keep: Optional[int] = None) -> str:
         dart = _dart_state(gbdt)
         if dart is not None:
             state["dart"] = dart
+        # engine-level callback state (early_stopping closure): the
+        # engine installs a provider returning a JSON-able dict; GBDT
+        # API users without one simply skip the section
+        provider = getattr(gbdt, "_engine_state_provider", None)
+        if provider is not None:
+            try:
+                engine_state = provider()
+            except Exception as e:  # noqa: BLE001 — a state provider
+                #                     bug must not void the checkpoint
+                log.warning("checkpoint: engine state provider failed "
+                            "(%r); callback state not captured" % (e,))
+                engine_state = None
+            if engine_state:
+                state["engine"] = engine_state
         model_text = gbdt.save_model_to_string()
         # deliberate host serialization point: the score bits leave
         # the device exactly once per checkpoint interval, never per

@@ -344,7 +344,12 @@ class MetricsHTTPServer:
 
     - ``GET /metrics``  → OpenMetrics text (the renderer above);
     - ``GET /healthz``  → JSON ``registry.snapshot()`` plus the
-      watchdog's currently-breached rules.
+      watchdog's currently-breached rules, plus — when the mounting
+      server provides a ``readiness`` callable — a readiness field
+      (``readiness``: ``ready``/``draining``/``stopped``, and the
+      boolean ``ready``) DISTINCT from liveness: a draining
+      PredictServer still answers scrapes while an external balancer
+      rotates it out.
 
     Binds ``host:port`` (``port=0`` picks a free ephemeral port —
     read it back from ``.port``); serves from a daemon thread. The
@@ -352,10 +357,11 @@ class MetricsHTTPServer:
     never raises into the socket loop."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
-                 reg=registry, watchdog=None) -> None:
+                 reg=registry, watchdog=None, readiness=None) -> None:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
         self.reg = reg
         self.watchdog = watchdog
+        self.readiness = readiness
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -368,6 +374,10 @@ class MetricsHTTPServer:
                         doc = {"snapshot": outer.reg.snapshot()}
                         if outer.watchdog is not None:
                             doc["breached"] = outer.watchdog.breached()
+                        if outer.readiness is not None:
+                            state = outer.readiness()
+                            doc["readiness"] = state
+                            doc["ready"] = state == "ready"
                         body = (json.dumps(doc) + "\n").encode()
                         ctype = "application/json"
                     else:

@@ -13,8 +13,10 @@ prefetch_device_put ShardPrefetcher worker staging (jax.device_put)
 spill_write        sharded construction shard spill (np.save)
 trace_finalize     streaming trace segment finalize (obs/trace.py)
 metrics_dump       OpenMetrics snapshot dump (obs/export.py)
-registry_swap      serve ModelRegistry.publish (model hot swap)
+registry_swap      serve ModelRegistry.publish AND canary promote
 checkpoint_finalize ft/checkpoint.py directory finalize (rename)
+serve_admit        PredictServer.submit admission (request intake)
+serve_dispatch     PredictServer worker dispatch (predictor.predict)
 ================== ====================================================
 
 A schedule is a ``;``-separated spec string (``LIGHTGBM_TPU_FAULTS``
@@ -55,7 +57,7 @@ _ENV = "LIGHTGBM_TPU_FAULTS"
 
 SITES = ("shard_open", "prefetch_device_put", "spill_write",
          "trace_finalize", "metrics_dump", "registry_swap",
-         "checkpoint_finalize")
+         "checkpoint_finalize", "serve_admit", "serve_dispatch")
 
 
 class InjectedFault(OSError):

@@ -136,6 +136,15 @@ def default_rules() -> List[WatchRule]:
       window at or above this = ``fault_storm`` — the run is limping
       on its retry layer (a flaky disk/runtime), act before the
       retries start exhausting;
+    - ``LIGHTGBM_TPU_WATCH_SHED_RATE`` (default 0.05): share of the
+      window's serve submissions shed by admission control
+      (``serve/shed_total`` delta over ``serve/requests`` delta) at or
+      above this = sustained overload — capacity, not a blip, is the
+      problem (a minimum of 8 sheds per window filters noise);
+    - ``serve/breaker_state`` at 2 (open) = ``breaker_open`` — the
+      serving worker is failing every dispatch and shedding load by
+      design; level-based like queue saturation, re-arms when the
+      half-open probe closes it;
     - backend fallback, trace drops, and exhausted retries
       (``retry_exhausted`` — some I/O site gave up after its bounded
       attempts, utils/retry.py) fire on ANY new occurrence.
@@ -144,8 +153,11 @@ def default_rules() -> List[WatchRule]:
     queue_thr = _env_float("LIGHTGBM_TPU_WATCH_QUEUE_DEPTH", 1024)
     stall_thr = _env_float("LIGHTGBM_TPU_WATCH_PREFETCH_STALL", 0.25)
     storm_thr = _env_float("LIGHTGBM_TPU_WATCH_RETRY_STORM", 16)
+    shed_thr = _env_float("LIGHTGBM_TPU_WATCH_SHED_RATE", 0.05)
     # below this much new stall time the share is noise, not starvation
     kMinStallMs = 50.0
+    # below this many sheds per window the rate is noise, not overload
+    kMinSheds = 8.0
 
     def retrace_spike(snap, state):
         delta = _counter_delta(snap, state, "jit_trace/", "prev",
@@ -238,13 +250,59 @@ def default_rules() -> List[WatchRule]:
                               "the retry layer)" % delta}
         return None
 
+    def shed_rate(snap, state):
+        # rate rule over the serving plane's admission control: the
+        # first snapshot arms both baselines (sheds before watching
+        # started are history), then the windowed shed share of
+        # submissions is the signal — absolute shed counts grow
+        # forever on a healthy server that survived one spike
+        shed = _counter_delta(snap, state,
+                              frozenset(("serve/shed_total",)),
+                              "prev_shed", first_is_baseline=True)
+        subs = _counter_delta(snap, state,
+                              frozenset(("serve/requests",)),
+                              "prev_req", first_is_baseline=True)
+        if shed < kMinSheds:
+            return None
+        share = shed / max(subs, shed, 1.0)
+        if share >= shed_thr:
+            return {"value": round(share, 4), "threshold": shed_thr,
+                    "detail": "admission control shed %d of %d serve "
+                              "submissions in one snapshot window "
+                              "(sustained overload)" % (shed, subs)}
+        return None
+
+    def breaker_open(snap, state):
+        # level-based like queue_saturation: one event per open
+        # episode, re-arms when the half-open probe closes the
+        # breaker. The gauge is a per-model FAMILY
+        # (serve/breaker_state/<model>) — the worst state across
+        # every breaker is the signal, so one server closing cannot
+        # mask another still open
+        worst = 0.0
+        for k, v in snap.get("gauges", {}).items():
+            if k == "serve/breaker_state" \
+                    or k.startswith("serve/breaker_state/"):
+                try:
+                    worst = max(worst, float(v))
+                except (TypeError, ValueError):
+                    continue
+        if worst >= 2:
+            return {"value": worst, "threshold": 2,
+                    "detail": "a serve circuit breaker is OPEN — every "
+                              "dispatch is failing and submits are "
+                              "being rejected fast"}
+        return None
+
     return [WatchRule("retrace_spike", retrace_spike),
             WatchRule("backend_fallback", backend_fallback),
             WatchRule("queue_saturation", queue_saturation),
             WatchRule("trace_drops", trace_drops),
             WatchRule("prefetch_stall", prefetch_stall),
             WatchRule("retry_exhausted", retry_exhausted),
-            WatchRule("fault_storm", fault_storm)]
+            WatchRule("fault_storm", fault_storm),
+            WatchRule("shed_rate", shed_rate),
+            WatchRule("breaker_open", breaker_open)]
 
 
 class Watchdog:

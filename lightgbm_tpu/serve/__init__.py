@@ -3,18 +3,26 @@ cache + micro-batching PredictServer / model registry.
 
 The training pipeline predicts one tree at a time (ops/predict.py);
 serving batches the FOREST: one jitted dispatch quantizes raw float rows
-against the model's own thresholds and walks all T trees via a vmapped
-lockstep traversal. See docs/SERVING.md for the array layout, the
-power-of-two bucket policy, and the queue semantics.
+against the model's own thresholds and walks all T trees. The server is
+overload-safe by construction — bounded queue with reject/block
+shedding, per-request deadline budgets, a circuit breaker over dispatch
+failures, canary model swaps with auto-rollback, and a graceful drain
+that never strands a Future. See docs/SERVING.md for the array layout,
+the power-of-two bucket policy, the queue semantics, and the typed
+error catalog.
 
 >>> from lightgbm_tpu.serve import PredictServer, StackedForest
 >>> forest = StackedForest.from_gbdt(booster)     # or a Booster directly
->>> server = PredictServer(forest, max_batch=256)
->>> server.predict(row)                           # coalesced micro-batch
+>>> server = PredictServer(forest, max_batch=256, max_queue_rows=4096)
+>>> server.predict(row, deadline_ms=50)           # coalesced micro-batch
 """
 from .cache import BucketedPredictor  # noqa: F401
 from .forest import StackedForest, round_down_f32  # noqa: F401
-from .server import ModelRegistry, PredictServer  # noqa: F401
+from .server import (BreakerOpen, CircuitBreaker,  # noqa: F401
+                     DeadlineExceeded, ModelRegistry, Overloaded,
+                     PredictServer, ServeError, ShuttingDown)
 
 __all__ = ["StackedForest", "BucketedPredictor", "ModelRegistry",
-           "PredictServer", "round_down_f32"]
+           "PredictServer", "round_down_f32", "ServeError", "Overloaded",
+           "DeadlineExceeded", "ShuttingDown", "BreakerOpen",
+           "CircuitBreaker"]

@@ -69,7 +69,10 @@ class BucketedPredictor:
             return self.forest.predict_raw(X)
         if kind == "leaf":
             return self.forest.leaves(X)
-        return np.asarray(self.forest.predict_raw_device(X))
+        import jax
+        # jaxlint: disable=JLT001 -- serving boundary: the f32 device
+        # sum comes home exactly once per dispatch, by design
+        return jax.device_get(self.forest.predict_raw_device(X))
 
     def predict(self, X, output_kind: Optional[str] = None) -> np.ndarray:
         """Predict with bucket padding; batches larger than

@@ -228,11 +228,17 @@ class StackedForest:
 
     def leaves(self, X) -> np.ndarray:
         """[n, T] leaf index of every row in every tree (one device
-        dispatch for quantize + forest walk)."""
-        Xd = self._prep(X)
+        dispatch for quantize + forest walk). Both transfers are
+        EXPLICIT (device_put in, device_get out) so a warmed serving
+        dispatch passes the transfer-guard sanitizer like the training
+        loop does."""
+        import jax
+        Xd = jax.device_put(self._prep(X))
         out = stacked_forest_leaves(Xd, self._qt, self._nodes,
                                     self._cat_lut, self.trips)
-        return np.asarray(out).T
+        # jaxlint: disable=JLT001 -- the serving boundary: leaf ids
+        # leave the device exactly once per dispatch, by design
+        return jax.device_get(out).T
 
     def predict_raw(self, X) -> np.ndarray:
         """Raw scores, bit-identical to ``GBDT.predict_raw``: device leaf
@@ -260,7 +266,8 @@ class StackedForest:
         """[n, K] f32 raw scores summed ON DEVICE — the serving
         throughput path (f32 accumulation: fast, not bit-identical to
         the host's f64 sum)."""
-        Xd = self._prep(X)
+        import jax
+        Xd = jax.device_put(self._prep(X))
         out = stacked_forest_raw(Xd, self._qt, self._nodes, self._cat_lut,
                                  self.trips, self.num_classes)
         if self.average_output and self.num_trees:

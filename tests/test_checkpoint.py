@@ -207,6 +207,50 @@ class TestEngineAPI:
                       resume=True)
         assert b.current_iteration == 3
 
+    def test_resume_under_early_stopping_parity(self, tmp_path):
+        """ISSUE 10 satellite: the engine-level early_stopping
+        callback's closure state rides the checkpoint (state.json
+        ``engine.early_stopping``), so a resumed run continues the SAME
+        patience window — same stop iteration, same best_iteration,
+        same model — instead of re-arming patience at the resume point
+        (which would train past the true stop and report a later
+        best)."""
+        X, y = self._xy()
+        Xv, yv = _data(250, seed=21)
+        params = dict(BASE, metric="binary_logloss", learning_rate=0.3,
+                      early_stopping_round=3)
+        kw = dict(valid_sets=[lgb.Dataset(Xv, label=yv)],
+                  valid_names=["v"])
+        full = lgb.train(params, lgb.Dataset(X, label=y),
+                         num_boost_round=80, **kw)
+        stop_iter = full.inner.iter
+        best = full.best_iteration
+        # a genuine patience stop, not the end-of-horizon check
+        assert stop_iter < 80 and stop_iter - best == 3, \
+            (stop_iter, best)
+        # interrupt mid-patience: past the best iteration, before stop
+        mid = best + 1
+        assert 0 < mid < stop_iter
+        ckdir = str(tmp_path / "ck")
+        lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=mid,
+                  checkpoint_dir=ckdir, checkpoint_freq=1, **kw)
+        resumed = lgb.train(params, lgb.Dataset(X, label=y),
+                            num_boost_round=80, checkpoint_dir=ckdir,
+                            resume=True, **kw)
+        # without the carried state the resumed run would re-arm: its
+        # first post-resume eval becomes a fresh "best" and training
+        # runs ~patience rounds past the true stop
+        assert resumed.inner.iter == stop_iter
+        assert resumed.best_iteration == best
+        assert resumed.best_score == full.best_score
+        assert resumed.inner.save_model_to_string() \
+            == full.inner.save_model_to_string()
+        # the checkpoint really carried the callback state
+        it, path = ckpt.list_checkpoints(ckdir)[0]
+        state = json.load(open(os.path.join(path, "state.json")))
+        es = state["engine"]["early_stopping"][0]
+        assert len(es["best_score"]) == 1 and es["best_iter"] == [best - 1]
+
     def test_resume_with_valid_sets_and_eval(self, tmp_path):
         X, y = self._xy()
         Xv, yv = _data(200, seed=9)

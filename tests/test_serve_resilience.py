@@ -1,0 +1,667 @@
+"""Resilient serving plane (ISSUE 10): admission control + deadline
+budgets, circuit breaker, canary swaps with auto-rollback, graceful
+drain, oversized-request splitting.
+
+Acceptance pins:
+
+- Overload: with ``max_queue_rows`` set and producers outrunning the
+  worker, queue depth stays bounded, shed requests fail with the typed
+  :class:`Overloaded` error (never hang), ``serve/shed_total`` +
+  ``request_shed`` events account for every shed — while accepted
+  requests return bit-identical predictions to the unloaded path.
+- Canary: an injected ``serve_dispatch`` fault during the canary
+  window rolls back to the prior version (old version keeps serving,
+  flushed ``model_rollback`` event) and a clean window promotes.
+- Drain: ``stop(drain_timeout_s=)`` leaves ZERO unresolved Futures
+  under every test, including a mid-drain fault injection.
+- A warmed serving dispatch performs no implicit transfers
+  (transfer-guard sanitizer over the worker thread, with the breaker
+  and canary machinery engaged).
+"""
+import math
+import threading
+import time
+import urllib.request
+import json as _json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import events
+from lightgbm_tpu.obs import faults
+from lightgbm_tpu.obs.registry import registry
+from lightgbm_tpu.serve import (BreakerOpen, DeadlineExceeded,
+                                ModelRegistry, Overloaded, PredictServer,
+                                ServeError, ShuttingDown, StackedForest)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    yield
+    faults.reset()
+    events.configure(None)
+    events.register_event_callback(None)
+    registry.disable()
+
+
+def _data(n=640, seed=11):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 6).astype(np.float32).astype(np.float64)
+    X[rng.rand(n) < 0.15, 2] = np.nan
+    X[:, 4] = rng.randint(0, 9, n)
+    y = (X[:, 0] + 0.5 * np.nan_to_num(X[:, 2])
+         + (X[:, 4] % 3 == 1) > 0.2).astype(float)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def shared():
+    """(X, bst, host_pred): one 640-row binary model with NaNs + a
+    categorical column, shared module-wide (single-core CPU budget)."""
+    X, y = _data()
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "max_bin": 63, "categorical_feature": [4]},
+                    lgb.Dataset(X, label=y), num_boost_round=12)
+    return X, bst, bst.predict(X, predict_on_device=False)
+
+
+def _events_of(path, kind):
+    return [r for r in events.read_jsonl(path) if r["event"] == kind]
+
+
+# ----------------------------------------------------------------------
+# admission control: bounded queue, reject/block, shedding accounting
+# ----------------------------------------------------------------------
+
+def test_overload_reject_sheds_bounded_and_bit_identical(shared,
+                                                         tmp_path):
+    """The acceptance overload pin: producer threads outrun the worker
+    (the coalescing window alone guarantees it), queue depth never
+    exceeds max_queue_rows, every shed fails typed AND is accounted
+    for by counter + event, no Future ever hangs, the worker survives,
+    and every accepted request's answer is bit-identical to the
+    unloaded path."""
+    path = str(tmp_path / "shed_events.jsonl")
+    events.configure(path)
+    X, bst, host = shared
+    base_shed = registry.count("serve/shed_total")
+    base_req = registry.count("serve/requests")
+    kCap = 64
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=256,
+                        max_wait_ms=50, max_queue_rows=kCap,
+                        overflow="reject")
+    n_threads, per = 8, 200
+    futs = [[None] * per for _ in range(n_threads)]
+    peaks = [0] * n_threads
+
+    def producer(t):
+        for i in range(per):
+            idx = (t * per + i) % len(X)
+            futs[t][i] = (idx, srv.submit(X[idx]))
+            peaks[t] = max(peaks[t], srv._pending_rows)
+
+    threads = [threading.Thread(target=producer, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+        assert not th.is_alive()
+    ok = shed = 0
+    for t in range(n_threads):
+        for idx, fut in futs[t]:
+            try:
+                val = fut.result(timeout=120)  # never hangs
+                assert val == host[idx]        # bit-identical answer
+                ok += 1
+            except Overloaded:
+                shed += 1
+    assert ok > 0 and shed > 0, (ok, shed)
+    assert ok + shed == n_threads * per
+    assert max(peaks) <= kCap, "queue depth exceeded max_queue_rows"
+    assert registry.count("serve/shed_total") - base_shed == shed
+    assert registry.count("serve/requests") - base_req \
+        == n_threads * per
+    # the worker survived the storm and still serves
+    assert srv._thread.is_alive()
+    deadline = time.perf_counter() + 10
+    while True:
+        try:
+            assert srv.predict(X[0], timeout=60) == host[0]
+            break
+        except Overloaded:
+            assert time.perf_counter() < deadline
+            time.sleep(0.05)
+    srv.stop()
+    events.configure(None)
+    shed_events = _events_of(path, "request_shed")
+    assert len(shed_events) == shed, \
+        "request_shed events must account for every shed"
+    assert all(e["reason"] == "queue_full" and e["model"] == "default"
+               for e in shed_events)
+
+
+def test_overload_block_policy_bounded_wait(shared):
+    """``overflow="block"`` backpressures the submitter for at most
+    block_timeout_ms: with no worker draining, the wait expires into a
+    typed shed; with a live worker, space frees and the same
+    backpressure resolves into service."""
+    X, bst, host = shared
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=8,
+                        max_wait_ms=1, max_queue_rows=8,
+                        overflow="block", block_timeout_ms=150,
+                        autostart=False)
+    f1 = srv.submit(X[:8])              # fills the queue exactly
+    t0 = time.perf_counter()
+    f2 = srv.submit(X[8:16])            # blocks, then sheds
+    waited = time.perf_counter() - t0
+    with pytest.raises(Overloaded, match="block_timeout"):
+        f2.result(timeout=5)
+    assert waited >= 0.1, "block policy must actually backpressure"
+    srv.start()
+    assert np.array_equal(f1.result(timeout=60), host[:8])
+    f3 = srv.submit(X[16:24])           # worker live: space frees
+    assert np.array_equal(f3.result(timeout=60), host[16:24])
+    srv.stop()
+
+
+def test_block_wait_bounded_by_request_deadline(shared):
+    """A blocked submitter never waits past its own deadline_ms: the
+    budget, not block_timeout, gives out first — and the failure says
+    so (DeadlineExceeded, not Overloaded)."""
+    X, bst, _ = shared
+    base = registry.count("serve/deadline_expired")
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=8,
+                        max_wait_ms=1, max_queue_rows=8,
+                        overflow="block", block_timeout_ms=2000,
+                        autostart=False)
+    srv.submit(X[:8])                   # fills the queue; no worker
+    t0 = time.perf_counter()
+    doomed = srv.submit(X[8:16], deadline_ms=60)
+    waited = time.perf_counter() - t0
+    with pytest.raises(DeadlineExceeded, match="queue space"):
+        doomed.result(timeout=5)
+    assert waited < 1.0, "blocked past the request's deadline"
+    assert registry.count("serve/deadline_expired") - base == 1
+    srv.stop(drain_timeout_s=0.1)
+
+
+def test_worker_survives_failure_outside_the_predict_call(shared,
+                                                          monkeypatch):
+    """Dispatch-path failures OUTSIDE the guarded predict (routing,
+    swap, concatenation) must fail the batch typed and keep the worker
+    alive — not kill the thread and strand every later submit."""
+    X, bst, host = shared
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=8,
+                        max_wait_ms=1, autostart=False)
+    orig_route = srv.registry.route
+    boom = [True]
+
+    def route_once(name):
+        if boom[0]:
+            boom[0] = False
+            raise MemoryError("routing blew up")
+        return orig_route(name)
+
+    monkeypatch.setattr(srv.registry, "route", route_once)
+    doomed = srv.submit(X[0])
+    srv.start()
+    with pytest.raises(MemoryError):
+        doomed.result(timeout=30)
+    assert srv._thread.is_alive(), "worker died on a non-predict error"
+    assert srv.predict(X[1], timeout=60) == host[1]
+    srv.stop()
+
+
+def test_deadline_checked_at_admission_and_dispatch_pop(shared):
+    X, bst, host = shared
+    base = registry.count("serve/deadline_expired")
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=16,
+                        max_wait_ms=1, autostart=False)
+    # admission check: an already-spent budget never touches the queue
+    f0 = srv.submit(X[0], deadline_ms=0)
+    with pytest.raises(DeadlineExceeded, match="admission"):
+        f0.result(timeout=5)
+    # pop check: a request that aged out while queued fails fast
+    # instead of wasting dispatch capacity; its neighbor is served
+    aged = srv.submit(X[1], deadline_ms=25)
+    keep = srv.submit(X[2])
+    time.sleep(0.08)
+    srv.start()
+    assert keep.result(timeout=60) == host[2]
+    with pytest.raises(DeadlineExceeded, match="aged out"):
+        aged.result(timeout=5)
+    assert registry.count("serve/deadline_expired") - base == 2
+    srv.stop()
+
+
+def test_default_deadline_applies_per_server(shared):
+    X, bst, host = shared
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=16,
+                        max_wait_ms=1, default_deadline_ms=30,
+                        autostart=False)
+    doomed = srv.submit(X[0])           # inherits the 30 ms budget
+    time.sleep(0.08)
+    srv.start()
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=5)
+    # an explicit generous budget overrides the default
+    assert srv.predict(X[1], timeout=60,
+                       deadline_ms=60_000) == host[1]
+    srv.stop()
+
+
+# ----------------------------------------------------------------------
+# oversized requests split across dispatches
+# ----------------------------------------------------------------------
+
+def test_oversized_request_split_and_reassembled(shared, tmp_path):
+    """A request with rows > max_batch is split into <= max_batch
+    chunks that dispatch independently; the Future's result is
+    reassembled bit-identically. No dispatch ever exceeds max_batch
+    (previously the whole block was admitted and pushed past the
+    predictor's bucket cap in one predict call)."""
+    path = str(tmp_path / "split_events.jsonl")
+    events.configure(path)
+    X, bst, host = shared
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=16,
+                        max_wait_ms=1)
+    out = srv.predict(X[:70], timeout=120)
+    single = srv.predict(X[70], timeout=60)
+    srv.stop()
+    events.configure(None)
+    assert np.array_equal(out, host[:70])
+    assert single == host[70]
+    assert srv.stats["dispatches"] >= math.ceil(70 / 16)
+    batches = _events_of(path, "predict_batch")
+    assert all(b["rows"] <= 16 for b in batches)
+    assert sum(b["rows"] for b in batches) == 71
+
+
+def test_oversized_request_larger_than_queue_is_shed(shared):
+    X, bst, _ = shared
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=16,
+                        max_queue_rows=32, autostart=False)
+    with pytest.raises(Overloaded, match="larger_than_queue"):
+        srv.submit(X[:40]).result(timeout=5)
+    srv.stop()
+
+
+# ----------------------------------------------------------------------
+# circuit breaker: open -> fail-fast -> half-open probe -> close
+# ----------------------------------------------------------------------
+
+def test_breaker_open_half_open_close_chaos(shared, tmp_path):
+    """Chaos pin: injected ``serve_dispatch`` faults drive the breaker
+    through its whole lifecycle — K consecutive failures open it,
+    submits fail fast with the state attached, a failed half-open
+    probe re-opens it, a clean probe closes it — with flushed
+    ``breaker_open``/``breaker_close`` events and the
+    ``serve/breaker_state`` gauge at every step."""
+    path = str(tmp_path / "breaker_events.jsonl")
+    events.configure(path)
+    X, bst, host = shared
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=8,
+                        max_wait_ms=1, breaker_threshold=2,
+                        breaker_cooldown_ms=200)
+    faults.configure("serve_dispatch:always")
+    with pytest.raises(faults.InjectedFault):
+        srv.predict(X[0], timeout=30)
+    assert srv.breaker.state == "closed"   # 1 failure < threshold
+    with pytest.raises(faults.InjectedFault):
+        srv.predict(X[1], timeout=30)
+    assert srv.breaker.state == "open"
+    assert registry.snapshot()["gauges"]["serve/breaker_state/default"] == 2
+    # fail-fast while open: typed, with breaker state attached
+    with pytest.raises(BreakerOpen) as ei:
+        srv.predict(X[2], timeout=5)
+    assert ei.value.state == "open"
+    assert ei.value.consecutive_failures >= 2
+    assert registry.count("serve/breaker_rejections") >= 1
+    time.sleep(0.25)
+    # half-open probe with the fault still firing: re-opens
+    with pytest.raises(faults.InjectedFault):
+        srv.predict(X[3], timeout=30)
+    assert srv.breaker.state == "open"
+    time.sleep(0.25)
+    faults.reset()
+    # clean half-open probe closes it; service resumes
+    assert srv.predict(X[4], timeout=60) == host[4]
+    assert srv.breaker.state == "closed"
+    assert registry.snapshot()["gauges"]["serve/breaker_state/default"] == 0
+    assert srv.predict(X[5], timeout=60) == host[5]
+    srv.stop()
+    events.configure(None)
+    opens = _events_of(path, "breaker_open")
+    closes = _events_of(path, "breaker_close")
+    assert len(opens) == 2 and len(closes) == 1
+    assert opens[0]["probe_failed"] is False
+    assert opens[1]["probe_failed"] is True
+    assert closes[0]["from_state"] == "half_open"
+
+
+# ----------------------------------------------------------------------
+# canary swaps: auto-rollback + promotion
+# ----------------------------------------------------------------------
+
+def test_canary_rollback_on_injected_dispatch_fault(shared, tmp_path):
+    """Acceptance pin: an injected ``serve_dispatch`` fault during the
+    canary window rolls back to the prior version — the old version
+    keeps serving (the very batch that caught the fault is replayed on
+    it), a flushed ``model_rollback`` event is emitted — and the canary
+    version never becomes the published one."""
+    path = str(tmp_path / "canary_events.jsonl")
+    events.configure(path)
+    X, bst, _ = shared
+    host3 = bst.predict(X, num_iteration=3, predict_on_device=False)
+    base_rb = registry.count("serve/rollbacks")
+    reg = ModelRegistry()
+    v1 = reg.load("m", booster=bst, num_iteration=3)
+    srv = PredictServer(reg, name="m", max_batch=32, max_wait_ms=1)
+    assert np.array_equal(srv.predict(X[:8], timeout=60), host3[:8])
+    v2 = reg.load("m", booster=bst, canary_batches=3)
+    assert (v1, v2) == (1, 2) and reg.canary_active("m")
+    faults.configure("serve_dispatch:nth:1")
+    # the canary dispatch faults -> auto-rollback; the caller is still
+    # served (by the rolled-back-to version)
+    out = srv.predict(X[:8], timeout=60)
+    faults.reset()
+    assert np.array_equal(out, host3[:8])
+    assert not reg.canary_active("m")
+    assert reg.get("m")[0] == v1           # v1 kept serving
+    assert np.array_equal(srv.predict(X[8:16], timeout=60),
+                          host3[8:16])
+    assert registry.count("serve/rollbacks") - base_rb == 1
+    srv.stop()
+    events.configure(None)
+    rb = _events_of(path, "model_rollback")
+    assert len(rb) == 1
+    assert rb[0]["version"] == v2 and rb[0]["rolled_back_to"] == v1
+    assert _events_of(path, "model_canary")[0]["version"] == v2
+
+
+def test_canary_clean_window_promotes(shared, tmp_path):
+    path = str(tmp_path / "promote_events.jsonl")
+    events.configure(path)
+    X, bst, host = shared
+    host3 = bst.predict(X, num_iteration=3, predict_on_device=False)
+    base_pr = registry.count("serve/canary_promotions")
+    reg = ModelRegistry()
+    v1 = reg.load("m", booster=bst, num_iteration=3)
+    srv = PredictServer(reg, name="m", max_batch=32, max_wait_ms=1)
+    assert np.array_equal(srv.predict(X[:8], timeout=60), host3[:8])
+    v2 = reg.load("m", booster=bst, canary_batches=2)  # full model
+    # canary routes the real traffic during its window
+    assert np.array_equal(srv.predict(X[:4], timeout=60), host[:4])
+    assert reg.canary_active("m")
+    assert np.array_equal(srv.predict(X[4:8], timeout=60), host[4:8])
+    # 2 clean batches: promoted
+    assert not reg.canary_active("m")
+    assert reg.get("m")[0] == v2
+    assert registry.count("serve/canary_promotions") - base_pr == 1
+    assert np.array_equal(srv.predict(X[8:16], timeout=60), host[8:16])
+    srv.stop()
+    events.configure(None)
+    swaps = [r for r in _events_of(path, "model_swap")
+             if r.get("canary")]
+    assert len(swaps) == 1 and swaps[0]["version"] == v2
+
+
+def test_canary_nonfinite_output_rolls_back(shared, tmp_path):
+    """A numerically poisoned canary (non-finite predictions) must not
+    survive its window even though it raises no exception."""
+    from lightgbm_tpu.models.tree import Tree
+    path = str(tmp_path / "nan_events.jsonl")
+    events.configure(path)
+    X, bst, host = shared
+    t = Tree(1)
+    t.leaf_value[0] = np.nan
+    poisoned = StackedForest([t], num_tree_per_iteration=1,
+                             num_features=X.shape[1])
+    reg = ModelRegistry()
+    v1 = reg.load("m", booster=bst)
+    srv = PredictServer(reg, name="m", max_batch=32, max_wait_ms=1)
+    assert np.array_equal(srv.predict(X[:8], timeout=60), host[:8])
+    v2 = reg.publish("m", poisoned, canary_batches=2)
+    out = srv.predict(X[:8], timeout=60)   # screened, rolled back,
+    assert np.array_equal(out, host[:8])   # replayed on v1
+    assert not reg.canary_active("m") and reg.get("m")[0] == v1
+    srv.stop()
+    events.configure(None)
+    rb = _events_of(path, "model_rollback")
+    assert len(rb) == 1 and "non-finite" in rb[0]["reason"]
+    assert rb[0]["version"] == v2
+
+
+def test_canary_promote_fault_fails_closed(shared):
+    """``registry_swap`` stays the fault site at the PROMOTE step too:
+    an injected fault there rolls back instead of publishing — the
+    swap is fail-closed end to end."""
+    X, bst, host = shared
+    host3 = bst.predict(X, num_iteration=3, predict_on_device=False)
+    reg = ModelRegistry()
+    v1 = reg.load("m", booster=bst, num_iteration=3)
+    srv = PredictServer(reg, name="m", max_batch=32, max_wait_ms=1)
+    assert np.array_equal(srv.predict(X[:4], timeout=60), host3[:4])
+    reg.load("m", booster=bst, canary_batches=1)
+    faults.configure("registry_swap:nth:1")  # fires at the promote
+    out = srv.predict(X[:4], timeout=60)
+    faults.reset()
+    assert np.array_equal(out, host[:4])  # the canary batch itself ran
+    assert not reg.canary_active("m")
+    assert reg.get("m")[0] == v1          # ... but v1 kept the slot
+    assert np.array_equal(srv.predict(X[4:8], timeout=60), host3[4:8])
+    srv.stop()
+
+
+# ----------------------------------------------------------------------
+# graceful drain: zero unresolved futures, always
+# ----------------------------------------------------------------------
+
+def test_stop_drains_queued_work_then_rejects_new(shared):
+    X, bst, host = shared
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=8,
+                        max_wait_ms=1, autostart=False)
+    futs = [srv.submit(X[i]) for i in range(10)]
+    srv.start()
+    srv.stop(drain_timeout_s=60)
+    for i, f in enumerate(futs):
+        assert f.result(timeout=5) == host[i]  # drained, not stranded
+    late = srv.submit(X[0])
+    with pytest.raises(ShuttingDown):
+        late.result(timeout=5)
+    assert srv.readiness == "stopped"
+
+
+def test_stop_without_worker_fails_queued_futures(shared, tmp_path):
+    path = str(tmp_path / "drain_events.jsonl")
+    events.configure(path)
+    X, bst, _ = shared
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=8,
+                        max_wait_ms=1, autostart=False)
+    futs = [srv.submit(X[i]) for i in range(3)]
+    srv.stop(drain_timeout_s=0.1)
+    for f in futs:
+        assert f.done()
+        with pytest.raises(ShuttingDown):
+            f.result(timeout=0)
+    events.configure(None)
+    ev = _events_of(path, "serve_drain_timeout")
+    assert len(ev) == 1 and ev[0]["unresolved"] == 3
+
+
+def test_drain_zero_unresolved_with_mid_drain_fault(shared):
+    """The acceptance pin's hard case: a ``serve_dispatch`` fault fires
+    WHILE the drain is flushing the queue — its batch fails typed, the
+    rest drain normally, zero Futures are left unresolved."""
+    X, bst, host = shared
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=4,
+                        max_wait_ms=1, autostart=False)
+    futs = [srv.submit(X[i * 4:(i + 1) * 4]) for i in range(3)]
+    faults.configure("serve_dispatch:nth:2")
+    srv.start()
+    srv.stop(drain_timeout_s=60)
+    faults.reset()
+    served, failed = 0, 0
+    for i, f in enumerate(futs):
+        assert f.done(), "drain left an unresolved Future"
+        try:
+            assert np.array_equal(f.result(timeout=0),
+                                  host[i * 4:(i + 1) * 4])
+            served += 1
+        except faults.InjectedFault:
+            failed += 1
+    assert (served, failed) == (2, 1)
+
+
+def test_stranded_probe_frees_breaker_slot(shared):
+    """A half-open probe stranded by the drain must free its slot: a
+    leaked slot would wedge the breaker half-open forever (every later
+    submit rejected, nothing ever dispatched to close it)."""
+    X, bst, host = shared
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=8,
+                        max_wait_ms=1, breaker_threshold=1,
+                        breaker_cooldown_ms=30, autostart=False)
+    srv.breaker.record_failure(RuntimeError("boom"))  # opens at K=1
+    assert srv.breaker.state == "open"
+    time.sleep(0.05)                    # cooldown elapses
+    probe = srv.submit(X[0])            # admitted as the probe
+    assert srv.breaker.state == "half_open"
+    with pytest.raises(BreakerOpen):    # slot taken: others fail fast
+        srv.submit(X[1]).result(timeout=5)
+    srv.stop(drain_timeout_s=0.1)       # strands the queued probe
+    with pytest.raises(ShuttingDown):
+        probe.result(timeout=5)
+    # restart: a fresh probe must be admitted and close the breaker
+    srv.start()
+    assert srv.predict(X[2], timeout=60) == host[2]
+    assert srv.breaker.state == "closed"
+    srv.stop()
+
+
+def test_drain_failed_counts_caller_requests_not_chunks(shared,
+                                                        tmp_path):
+    """An oversized request stranded at the drain timeout is ONE
+    unresolved caller Future, not one per split chunk."""
+    path = str(tmp_path / "drain_count_events.jsonl")
+    events.configure(path)
+    X, bst, _ = shared
+    base = registry.count("serve/drain_failed")
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=8,
+                        max_wait_ms=1, autostart=False)
+    fut = srv.submit(X[:40])            # 5 chunks, one caller Future
+    srv.stop(drain_timeout_s=0.05)
+    with pytest.raises(ShuttingDown):
+        fut.result(timeout=5)
+    assert registry.count("serve/drain_failed") - base == 1
+    events.configure(None)
+    ev = _events_of(path, "serve_drain_timeout")
+    assert len(ev) == 1 and ev[0]["unresolved"] == 1
+
+
+def test_drain_timeout_fails_wedged_inflight_future(shared,
+                                                    monkeypatch):
+    """A wedged dispatch cannot strand its Future past the drain
+    timeout: stop() fails it typed and returns on time; the worker's
+    late set_result loses the race harmlessly."""
+    X, bst, _ = shared
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=8,
+                        max_wait_ms=1, autostart=False)
+    orig = srv.predictor.predict
+
+    def wedged(Xb):
+        time.sleep(1.5)
+        return orig(Xb)
+
+    monkeypatch.setattr(srv.predictor, "predict", wedged)
+    fut = srv.submit(X[0])
+    srv.start()
+    time.sleep(0.3)                     # worker is inside the dispatch
+    t0 = time.perf_counter()
+    srv.stop(drain_timeout_s=0.2)
+    assert time.perf_counter() - t0 < 1.2
+    with pytest.raises(ShuttingDown):
+        fut.result(timeout=5)
+    assert srv.readiness == "stopped"
+    srv._thread.join(timeout=10)        # worker exits cleanly after
+
+
+# ----------------------------------------------------------------------
+# /healthz readiness (distinct from liveness)
+# ----------------------------------------------------------------------
+
+def test_healthz_readiness_distinct_from_liveness(shared):
+    X, bst, host = shared
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=16,
+                        max_wait_ms=1, metrics_port=0)
+    try:
+        doc = _json.loads(urllib.request.urlopen(
+            srv.metrics.url + "/healthz", timeout=30).read().decode())
+        assert doc["readiness"] == "ready" and doc["ready"] is True
+        assert srv.predict(X[0], timeout=60) == host[0]
+        # close admission (what stop() does first): the listener still
+        # answers — liveness — but readiness flips so a balancer can
+        # rotate the worker out while it drains
+        with srv._cond:
+            srv._stop = True
+            srv._cond.notify_all()
+        doc = _json.loads(urllib.request.urlopen(
+            srv.metrics.url + "/healthz", timeout=30).read().decode())
+        assert doc["readiness"] == "draining" and doc["ready"] is False
+    finally:
+        srv.stop()
+    assert srv.readiness == "stopped"
+
+
+# ----------------------------------------------------------------------
+# typed error catalog
+# ----------------------------------------------------------------------
+
+def test_typed_error_catalog():
+    for exc in (Overloaded, DeadlineExceeded, ShuttingDown,
+                BreakerOpen):
+        assert issubclass(exc, ServeError)
+        assert issubclass(exc, RuntimeError)
+    # fault-injection errors are OSErrors, NOT ServeErrors: overload
+    # policy and injected/real I/O failure stay distinguishable
+    assert not issubclass(faults.InjectedFault, ServeError)
+
+
+# ----------------------------------------------------------------------
+# transfer-guard: warmed serve dispatch, breaker/canary paths engaged
+# ----------------------------------------------------------------------
+
+def test_serve_dispatch_no_implicit_transfers_warmed(shared):
+    """A warmed serving dispatch performs ZERO implicit transfers: the
+    row batch enters via an explicit device_put, leaf ids leave via an
+    explicit device_get (serve/forest.py), and the breaker + canary
+    bookkeeping on the hot path is pure host work. The guard is set
+    GLOBALLY so it covers the worker thread, where the dispatch
+    actually runs."""
+    import jax
+    X, bst, _ = shared
+    host_raw = bst.predict(X, raw_score=True, predict_on_device=False)
+    reg = ModelRegistry()
+    reg.load("m", booster=bst)
+    srv = PredictServer(reg, name="m", max_batch=32, max_wait_ms=1,
+                        output_kind="raw")
+    try:
+        for _ in range(2):  # warm the bucket compile + swap machinery
+            assert np.array_equal(srv.predict(X[:16], timeout=60),
+                                  host_raw[:16])
+        # engage the canary path (publish -> canary dispatch ->
+        # promote) so its machinery is warm too
+        reg.load("m", booster=bst, canary_batches=1)
+        assert np.array_equal(srv.predict(X[:16], timeout=60),
+                              host_raw[:16])
+        assert not reg.canary_active("m")
+        jax.config.update("jax_transfer_guard", "disallow")
+        try:
+            out = srv.predict(X[:16], timeout=60)
+        finally:
+            jax.config.update("jax_transfer_guard", "allow")
+        assert np.array_equal(out, host_raw[:16])
+    finally:
+        srv.stop()

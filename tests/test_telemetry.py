@@ -557,6 +557,55 @@ def test_watchdog_retry_exhausted_and_fault_storm():
     assert registry.count("health/fault_storm") == 2
 
 
+def test_watchdog_shed_rate_and_breaker_open():
+    """The serving-plane rules (ISSUE 10): ``shed_rate`` is a windowed
+    rate over serve/shed_total vs serve/requests (with a minimum-shed
+    noise floor), ``breaker_open`` is level-based on the
+    serve/breaker_state gauge — both once-per-breach with re-arm."""
+    registry.reset()
+    seen = []
+    events.register_event_callback(
+        lambda rec: seen.append(rec) if rec["event"] == "health" else None)
+    wd = Watchdog(registry)
+    assert wd.evaluate() == []              # arms baselines
+
+    # shed_rate: 10 of 100 submissions shed in one window (>= 5%)
+    registry.inc("serve/requests", 100)
+    registry.inc("serve/shed_total", 10)
+    assert [f["rule"] for f in wd.evaluate()] == ["shed_rate"]
+    assert wd.evaluate() == []              # spike passed: re-armed
+    # sub-floor trickle never fires, even at a high ratio
+    registry.inc("serve/requests", 4)
+    registry.inc("serve/shed_total", 3)
+    assert wd.evaluate() == []
+    # healthy traffic with a sub-threshold shed share stays quiet
+    registry.inc("serve/requests", 1000)
+    registry.inc("serve/shed_total", 9)     # above floor, < 5% share
+    assert wd.evaluate() == []
+    # second genuine overload episode fires again
+    registry.inc("serve/requests", 50)
+    registry.inc("serve/shed_total", 50)
+    fired = wd.evaluate()
+    assert [f["rule"] for f in fired] == ["shed_rate"]
+    assert 0 < fired[0]["value"] <= 1.0
+
+    # breaker_open: level-based on the gauge, re-arms on close
+    registry.gauge("serve/breaker_state", 2)
+    assert [f["rule"] for f in wd.evaluate()] == ["breaker_open"]
+    assert wd.evaluate() == []              # still open: once only
+    registry.gauge("serve/breaker_state", 0)
+    assert wd.evaluate() == []              # closed: re-armed
+    registry.gauge("serve/breaker_state", 2)
+    assert [f["rule"] for f in wd.evaluate()] == ["breaker_open"]
+
+    events.register_event_callback(None)
+    rules = [r["rule"] for r in seen]
+    assert rules.count("shed_rate") == 2
+    assert rules.count("breaker_open") == 2
+    assert registry.count("health/shed_rate") == 2
+    assert registry.count("health/breaker_open") == 2
+
+
 def test_watchdog_inline_tick_env(monkeypatch):
     """LIGHTGBM_TPU_WATCHDOG=1 routes per-iteration ticks through the
     default watchdog even without a metrics file exporter."""
