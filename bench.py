@@ -859,10 +859,22 @@ def run_serve_bench() -> dict:
        ``serve_dispatch`` fault must auto-roll back while callers keep
        being served, and a clean canary window must promote
        (``serve_rollbacks``).
+    4. **fleet**: a subprocess forced to
+       ``--xla_force_host_platform_device_count=N`` (BENCH_SERVE_REPLICAS,
+       default 4) measures the mesh-replicated server: single-replica
+       baseline vs N-replica aggregate rows/s (``serve_replicas``,
+       ``serve_aggregate_rows_per_sec``, ``serve_scaling_x``),
+       per-replica p99 (``serve_p99_ms_by_replica``), shed behaviour at
+       ~N× the single-replica saturation load, a zero-new-traces
+       retrace budget across replicas, and zero stranded Futures. The
+       2.5× aggregate floor is enforced when the container has a core
+       per replica (``_fleet_scaling_floor``) — a 1-core box cannot
+       physically parallelize and reports honest numbers instead.
 
     Exit is nonzero (``serve_ok`` false) if the overload segment sheds
-    nothing, any Future hangs, an accepted answer deviates, or the
-    rollback/promote contract breaks.
+    nothing, any Future hangs, an accepted answer deviates, the
+    rollback/promote contract breaks, or the fleet segment misses its
+    scaling floor / trace budget / no-stranded-futures contract.
 
     Env knobs: BENCH_SERVE_ROWS (40k model-training rows),
     BENCH_SERVE_ITERS (12 trained iterations), BENCH_SERVE_BUDGET
@@ -1002,6 +1014,10 @@ def run_serve_bench() -> dict:
     rollbacks = obs_registry.count("serve/rollbacks") - rb0
     _stage("serve_canary", rollbacks=rollbacks, promoted=promoted)
 
+    # ---- segment 4: mesh-replicated fleet (subprocess: the forced
+    # host-device count must be set before jax initializes) -----------
+    fleet = _run_serve_fleet_segment(bst, problems)
+
     serve_ok = not problems
     _stage("serve_done", rows_per_sec=round(rps, 1),
            p99_ms=round(p99, 3),
@@ -1013,9 +1029,11 @@ def run_serve_bench() -> dict:
         "value": round(rps, 1),
         "unit": "rows/s on %s (%d threads; p99 %.2f ms; overload shed "
                 "%.0f%% of %d, 0 hung; canary rollbacks %d, promote "
-                "%s%s)"
+                "%s; fleet %dx replicas %.2fx aggregate%s)"
                 % (platform, n_threads, p99, 100 * shed_fraction,
                    total, rollbacks, promoted,
+                   fleet.get("serve_replicas", 0),
+                   fleet.get("serve_scaling_x", 0.0),
                    "" if serve_ok else "; PROBLEMS: "
                    + "; ".join(problems)),
         "backend": platform,
@@ -1024,6 +1042,251 @@ def run_serve_bench() -> dict:
         "serve_shed_fraction": round(shed_fraction, 4),
         "serve_rollbacks": rollbacks,
         "serve_ok": bool(serve_ok),
+        **fleet,
+    }
+
+
+def _fleet_scaling_floor(replicas: int, cores: int) -> float:
+    """The aggregate-throughput floor the fleet must clear vs the
+    single-replica configuration. With >= one core per replica the full
+    2.5x contract is enforced; on core-starved containers (this repo's
+    CI box is 1-core) real parallel scaling is physically impossible,
+    so the floor is report-only (0.0) and the honest numbers still land
+    in the JSON for the TPU re-measure (ROADMAP standing note); the
+    trace-budget / zero-stranded / parity contracts stay enforced
+    everywhere."""
+    if cores >= replicas:
+        return 2.5
+    return 0.0
+
+
+def _run_serve_fleet_segment(bst, problems: list) -> dict:
+    """Spawn the fleet child under a forced host-device count and fold
+    its keys into the serve result (first-class: serve_replicas,
+    serve_aggregate_rows_per_sec, per-replica serve_p99_ms)."""
+    import subprocess
+    import tempfile
+
+    replicas = int(os.environ.get("BENCH_SERVE_REPLICAS", 4))
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as f:
+        f.write(bst.model_to_string())
+        model_path = f.name
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=%d"
+                        % replicas).strip()
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["BENCH_SERVE_REPLICAS"] = str(replicas)
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "_serve_fleet",
+             model_path],
+            capture_output=True, text=True, timeout=float(
+                os.environ.get("BENCH_SERVE_FLEET_TIMEOUT", 600)),
+            env=env)
+        child = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        problems.append("fleet child failed: %s: %s"
+                        % (type(e).__name__, str(e)[:200]))
+        child = {"ok": False, "problems": ["child did not report"]}
+    finally:
+        try:
+            os.unlink(model_path)
+        except OSError:
+            pass
+    for p in child.get("problems", []):
+        problems.append("fleet: %s" % p)
+    _stage("serve_fleet", **{k: v for k, v in child.items()
+                             if k != "problems"})
+    return {
+        "serve_replicas": child.get("replicas", 0),
+        "serve_aggregate_rows_per_sec":
+            child.get("rps_fleet", 0.0),
+        "serve_single_replica_rows_per_sec":
+            child.get("rps_single", 0.0),
+        "serve_scaling_x": child.get("scaling_x", 0.0),
+        "serve_scaling_floor": child.get("scaling_floor", 0.0),
+        "serve_p99_ms_by_replica": child.get("p99_by_replica", {}),
+        "serve_fleet_shed_fraction":
+            child.get("fleet_shed_fraction", 1.0),
+        "serve_fleet_new_traces": child.get("new_traces", -1),
+        "serve_fleet_ok": bool(child.get("ok", False)),
+    }
+
+
+def run_serve_fleet_child(model_file: str) -> dict:
+    """The fleet measurement (runs in its own process so the parent can
+    force ``--xla_force_host_platform_device_count``):
+
+    1. single-replica saturation throughput (the baseline);
+    2. N-replica fleet on N devices under the same producer pressure —
+       aggregate rows/s, per-replica p99, zero new serve.* traces
+       beyond the single-replica count (the shared compile cache);
+    3. overload at ~N× the single-replica saturation load with a
+       bounded queue — sheds must be typed+counted, accepted answers
+       bit-identical to the host walk, and ZERO futures may hang.
+
+    ``ok`` enforces the scaling floor (2.5x when the container actually
+    has a core per replica — see ``_fleet_scaling_floor``), the trace
+    budget, and the zero-stranded-futures contract."""
+    import threading
+
+    import jax
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import compile as obs_compile
+    from lightgbm_tpu.obs.registry import registry as obs_registry
+    from lightgbm_tpu.serve import (Overloaded, PredictServer,
+                                    StackedForest)
+
+    obs_registry.enable()
+    replicas = int(os.environ.get("BENCH_SERVE_REPLICAS", 4))
+    budget = float(os.environ.get("BENCH_SERVE_FLEET_BUDGET", 5.0))
+    n_devices = len(jax.devices())
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cores = os.cpu_count() or 1
+    problems = []
+    if n_devices < replicas:
+        problems.append("only %d devices for %d replicas"
+                        % (n_devices, replicas))
+    bst = lgb.Booster(model_file=model_file)
+    forest = StackedForest.from_gbdt(bst)
+    rows_per_block = int(os.environ.get("BENCH_SERVE_FLEET_BLOCK", 512))
+    X, _ = make_higgs_like(4096, forest.num_features, seed=7)
+    X = np.ascontiguousarray(X, dtype=np.float32)
+    host_ref = np.asarray(bst.predict(X[:rows_per_block],
+                                      predict_on_device=False))
+
+    def saturate(srv, n_threads, seconds):
+        served = [0] * n_threads
+        t_end = time.time() + seconds
+
+        def pump(t):
+            blk = X[(t * 128) % 2048:][:rows_per_block]
+            while time.time() < t_end:
+                srv.predict(blk, timeout=300)
+                served[t] += blk.shape[0]
+
+        t0 = time.time()
+        threads = [threading.Thread(target=pump, args=(t,))
+                   for t in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        return sum(served) / max(time.time() - t0, 1e-9)
+
+    # --- 1. single-replica baseline ---------------------------------
+    srv = PredictServer(forest, max_batch=rows_per_block * 2,
+                        max_wait_ms=2)
+    srv.predict(X[:rows_per_block], timeout=300)  # warm buckets
+    srv.predict(X[:rows_per_block * 2], timeout=300)
+    n_pump = max(2, min(4, cores))
+    rps_single = saturate(srv, n_pump, budget)
+    srv.stop()
+
+    # --- 2. fleet throughput + trace budget --------------------------
+    # producer pressure scales with the cores that exist to absorb it:
+    # on a core-per-replica box the fleet gets Nx producers (the 2.5x
+    # floor applies); a core-starved box gets the SAME pressure as the
+    # single-replica baseline, so the comparison measures replication
+    # overhead honestly instead of thread thrash
+    fleet_pump = n_pump * (replicas if cores >= replicas else 1)
+    t0 = {k: v for k, v in obs_compile.trace_counts().items()
+          if k.startswith("serve.")}
+    srv = PredictServer(forest, max_batch=rows_per_block * 2,
+                        max_wait_ms=2, replicas=replicas)
+    srv.warm(X[:rows_per_block])       # per-device XLA compiles up front
+    srv.warm(X[:rows_per_block * 2])
+    check = np.asarray(srv.predict(X[:rows_per_block], timeout=300))
+    if not np.array_equal(check, host_ref):
+        problems.append("fleet answers deviate from host predict")
+    rps_fleet = saturate(srv, fleet_pump, budget)
+    p99_by_replica = {str(k): round(v["p99_ms"], 3)
+                      for k, v in srv.replica_stats().items()}
+    srv.stop()
+    t1 = {k: v for k, v in obs_compile.trace_counts().items()
+          if k.startswith("serve.")}
+    new_traces = sum(t1.get(k, 0) - t0.get(k, 0)
+                     for k in set(t1) | set(t0))
+    if new_traces:
+        problems.append("%d new serve traces beyond the single-replica "
+                        "count" % new_traces)
+
+    # --- 3. overload at ~Nx the single-replica saturation load -------
+    shed0 = obs_registry.count("serve/shed_total")
+    srv = PredictServer(forest, max_batch=rows_per_block,
+                        max_wait_ms=10, replicas=replicas,
+                        max_queue_rows=rows_per_block * replicas,
+                        overflow="reject")
+    srv.predict(X[:64], timeout=300)
+    futs = []
+    lock = threading.Lock()
+    n_load = n_pump * replicas * 2
+    per = max(int(budget * rps_single * 2 / max(64 * n_load, 1)), 20)
+
+    def flood(t):
+        mine = []
+        for i in range(per):
+            idx = (t * per + i) % rows_per_block
+            mine.append((idx, srv.submit(X[idx])))
+        with lock:
+            futs.extend(mine)
+
+    threads = [threading.Thread(target=flood, args=(t,))
+               for t in range(n_load)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    ok = shed = hung = wrong = 0
+    for idx, fut in futs:
+        try:
+            val = fut.result(timeout=300)
+            ok += 1
+            if val != host_ref[idx]:
+                wrong += 1
+        except Overloaded:
+            shed += 1
+        except Exception:
+            hung += 1
+    srv.stop()
+    shed_counted = obs_registry.count("serve/shed_total") - shed0
+    fleet_shed_fraction = shed / max(len(futs), 1)
+    if hung:
+        problems.append("%d fleet futures hung or failed untyped" % hung)
+    if wrong:
+        problems.append("%d accepted fleet answers deviated" % wrong)
+    if shed_counted != shed:
+        problems.append("fleet shed accounting mismatch (%d counted, "
+                        "%d observed)" % (shed_counted, shed))
+    if cores >= replicas and fleet_shed_fraction > 0.5:
+        # with a core per replica the fleet has ~Nx capacity: an Nx
+        # load must NOT shed a majority (the PR 10 shed-rate SLO scaled
+        # to the fleet); core-starved boxes report honestly instead
+        problems.append("fleet shed %.0f%% at %dx load with %d cores"
+                        % (100 * fleet_shed_fraction, replicas, cores))
+
+    scaling = rps_fleet / max(rps_single, 1e-9)
+    floor = _fleet_scaling_floor(replicas, cores)
+    if scaling < floor:
+        problems.append("aggregate scaling %.2fx under the %.2fx floor "
+                        "(%d cores)" % (scaling, floor, cores))
+    return {
+        "replicas": replicas, "devices": n_devices, "cores": cores,
+        "rps_single": round(rps_single, 1),
+        "rps_fleet": round(rps_fleet, 1),
+        "scaling_x": round(scaling, 3),
+        "scaling_floor": round(floor, 3),
+        "p99_by_replica": p99_by_replica,
+        "fleet_shed_fraction": round(fleet_shed_fraction, 4),
+        "fleet_submitted": len(futs), "fleet_served": ok,
+        "fleet_hung": hung,
+        "new_traces": new_traces,
+        "ok": not problems, "problems": problems,
     }
 
 
@@ -1283,6 +1546,17 @@ def _run_escalating(platform: str) -> dict:
 
 
 def main() -> None:
+    if len(sys.argv) > 2 and sys.argv[1] == "_serve_fleet":
+        # internal: the multi-replica fleet measurement child (the
+        # parent sets XLA_FLAGS=--xla_force_host_platform_device_count
+        # before jax can initialize). One JSON line on stdout.
+        try:
+            print(json.dumps(run_serve_fleet_child(sys.argv[2])))
+        except Exception as e:
+            print(json.dumps({"ok": False, "problems": [
+                "%s: %s" % (type(e).__name__, str(e)[:300])]}))
+            sys.exit(1)
+        return
     if (os.environ.get("BENCH_STREAM")
             or (len(sys.argv) > 1 and sys.argv[1] == "stream")):
         # streaming-telemetry smoke: CPU is fine (the spool is

@@ -405,16 +405,33 @@ class Booster:
     # possible first-bucket compile) only pays off on real batches
     _kDeviceMinRows = 256
 
+    @staticmethod
+    def _host_walk_warning(reason: str) -> None:
+        """A FORCED device predict that must decline emits an assertable
+        ``perf_warning`` event (never silent — the round-5 lesson): the
+        ISSUE 11 contract is that linear-leaf, EFB-bundled, and f64
+        batches all take the device fast path, so any remaining host
+        walk under ``predict_on_device=True`` is an exception worth
+        surfacing."""
+        from .obs import events as obs_events
+        from .utils import log
+        log.warning("predict_on_device declined to the host walk: %s"
+                    % reason)
+        obs_events.emit("perf_warning", component="serve.host_walk",
+                        message=reason)
+
     def _predict_stacked(self, X: np.ndarray, start_iteration: int,
                          num_iteration: int, raw_score: bool,
                          kwargs: Dict) -> Optional[np.ndarray]:
         """Fast path: one device dispatch through serve.StackedForest
-        (bucketed compile cache kept across calls). Returns None — fall
-        back to the host walk — whenever the stacked path cannot
-        reproduce the host result BIT-FOR-BIT: linear leaves,
-        pred_early_stop, f64 rows the f32 quantizer cannot represent
-        exactly, feature-count mismatch, or mixed per-feature missing
-        types (text-loaded edge case)."""
+        (bucketed compile cache kept across calls). Linear-leaf models
+        pack their per-leaf fits into the stacked arrays and f64 rows
+        ride the double-double encoding, so both keep the bit-exact
+        device path. Returns None — fall back to the host walk — only
+        when the stacked path cannot reproduce the host result
+        BIT-FOR-BIT: pred_early_stop, feature-count mismatch, or mixed
+        per-feature missing types (text-loaded edge case); a FORCED
+        decline emits a ``perf_warning`` event."""
         forced = kwargs.get("predict_on_device")
         if forced is not None and not forced:
             return None
@@ -430,16 +447,20 @@ class Booster:
             if jax.default_backend() == "cpu":
                 return None
         if self.config.pred_early_stop or kwargs.get("pred_early_stop"):
+            if forced:
+                self._host_walk_warning(
+                    "pred_early_stop is a host-loop contract")
             return None
         inner = self.inner
         models = inner._used_models(start_iteration, num_iteration)
-        if not models or any(t.is_linear for t in models):
+        if not models:
             return None
         if X.shape[1] != inner.max_feature_idx + 1:
+            if forced:
+                self._host_walk_warning(
+                    "feature count %d != model's %d"
+                    % (X.shape[1], inner.max_feature_idx + 1))
             return None
-        if not np.all((X.astype(np.float32).astype(np.float64) == X)
-                      | np.isnan(X)):
-            return None  # rows exceed f32 precision: exactness would break
         # cache the packed forest until the model slice changes. Object
         # identity is not enough: refit and DART normalization mutate
         # leaf values IN PLACE, so the key fingerprints the leaf
@@ -449,6 +470,8 @@ class Booster:
         fp = hashlib.blake2b(digest_size=8)
         for t in models:
             fp.update(t.leaf_value[:t.num_leaves].tobytes())
+            if t.is_linear:
+                fp.update(t.leaf_const[:t.num_leaves].tobytes())
         key = (len(inner.models), fp.hexdigest(),
                start_iteration, num_iteration)
         cached = getattr(self, "_stacked_cache", None)
@@ -457,7 +480,10 @@ class Booster:
             try:
                 forest = StackedForest.from_gbdt(inner, start_iteration,
                                                  num_iteration)
-            except ValueError:
+            except ValueError as e:
+                if forced:
+                    self._host_walk_warning(
+                        "model cannot stack: %s" % e)
                 self._stacked_cache = (key, None)
                 return None
             self._stacked_cache = (key, BucketedPredictor(
@@ -465,6 +491,8 @@ class Booster:
             cached = self._stacked_cache
         predictor = cached[1]
         if predictor is None:
+            if forced:
+                self._host_walk_warning("model cannot stack (cached)")
             return None
         kind = ("raw" if raw_score or inner.objective is None
                 else "value")

@@ -50,6 +50,7 @@ kPrefix = "lightgbm_tpu_"
 kDefaultIntervalS = 10.0
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+_REPLICA_RE = re.compile(r"^(.*)/replica/(\d+)(?:/model/(.+))?$")
 
 
 def _san(name: str) -> str:
@@ -57,6 +58,22 @@ def _san(name: str) -> str:
     if not s or s[0].isdigit():
         s = "_" + s
     return s
+
+
+def _split_replica(name: str):
+    """``serve/latency_ms/replica/3/model/m`` →
+    (``serve/latency_ms``, (("model", "m"), ("replica", "3"))): a
+    serving fleet's per-replica series render as ONE family with
+    ``replica`` (and ``model``) labels, so a single scrape target
+    covers all replicas of every server in the process (the
+    per-process /metrics gap from the ROADMAP)."""
+    m = _REPLICA_RE.match(name)
+    if m is None:
+        return name, None
+    labels = [("replica", m.group(2))]
+    if m.group(3) is not None:
+        labels.append(("model", m.group(3)))
+    return m.group(1), tuple(sorted(labels))
 
 
 def _esc(label_value: str) -> str:
@@ -71,6 +88,15 @@ def _fmt(v) -> str:
     return repr(f)
 
 
+def _lbl(labels, extra=()) -> str:
+    """Render a ``{k="v",...}`` label block (empty string when there
+    are no labels)."""
+    pairs = list(labels or ()) + list(extra)
+    if not pairs:
+        return ""
+    return "{%s}" % ",".join('%s="%s"' % (k, _esc(v)) for k, v in pairs)
+
+
 def render_openmetrics(reg=registry) -> str:
     """Serialize one consistent registry snapshot as OpenMetrics-style
     text (``# TYPE`` headers, ``{label="..."}`` pairs, ``# EOF``
@@ -83,6 +109,10 @@ def render_openmetrics(reg=registry) -> str:
       → ``<name>_info{value="..."} 1``;
     - histograms (``registry.observe``) → summary families with
       ``quantile="0.5"/"0.99"`` samples + ``_count``;
+    - per-replica serving series (``<base>/replica/<k>`` counters and
+      histograms, e.g. ``serve/latency_ms/replica/0``) fold into ONE
+      family carrying a ``replica="k"`` label, so one scrape target
+      covers a whole replicated serving fleet;
     - the stage timer → ``stage_seconds_total{stage=...}`` /
       ``stage_calls_total{stage=...}`` /
       ``stage_duration_ms{stage=...,quantile=...}``.
@@ -95,10 +125,18 @@ def render_openmetrics(reg=registry) -> str:
              if not k.startswith("jit_trace/")}
     jit = {k[len("jit_trace/"):]: v for k, v in counters.items()
            if k.startswith("jit_trace/")}
-    for name, v in sorted(plain.items()):
-        m = kPrefix + _san(name) + "_total"
+    # fold per-replica counters into one labeled family per base name
+    # (the samples of a family must stay contiguous under one # TYPE)
+    families: Dict[str, list] = {}
+    for name, v in plain.items():
+        base, labels = _split_replica(name)
+        families.setdefault(base, []).append((labels, v))
+    for base in sorted(families):
+        m = kPrefix + _san(base) + "_total"
         out.append("# TYPE %s counter" % m)
-        out.append("%s %s" % (m, _fmt(v)))
+        for labels, v in sorted(families[base],
+                                key=lambda lv: lv[0] or ()):
+            out.append("%s%s %s" % (m, _lbl(labels), _fmt(v)))
     if jit:
         m = kPrefix + "jit_traces_total"
         out.append("# TYPE %s counter" % m)
@@ -127,12 +165,21 @@ def render_openmetrics(reg=registry) -> str:
         for fn, v in sorted(by_fn.items()):
             out.append('%s{fn="%s"} %s' % (m, _esc(fn), _fmt(v)))
 
-    for name, h in sorted(snap.get("hists", {}).items()):
-        m = kPrefix + _san(name)
+    hfams: Dict[str, list] = {}
+    for name, h in snap.get("hists", {}).items():
+        base, labels = _split_replica(name)
+        hfams.setdefault(base, []).append((labels, h))
+    for base in sorted(hfams):
+        m = kPrefix + _san(base)
         out.append("# TYPE %s summary" % m)
-        out.append('%s{quantile="0.5"} %s' % (m, _fmt(h["p50"])))
-        out.append('%s{quantile="0.99"} %s' % (m, _fmt(h["p99"])))
-        out.append("%s_count %s" % (m, _fmt(h["count"])))
+        for labels, h in sorted(hfams[base],
+                                key=lambda lh: lh[0] or ()):
+            out.append("%s%s %s" % (m, _lbl(labels, [("quantile", "0.5")]),
+                                    _fmt(h["p50"])))
+            out.append("%s%s %s" % (m, _lbl(labels, [("quantile", "0.99")]),
+                                    _fmt(h["p99"])))
+            out.append("%s_count%s %s" % (m, _lbl(labels),
+                                          _fmt(h["count"])))
 
     phases = snap.get("phases", {})
     if phases:

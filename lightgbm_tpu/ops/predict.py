@@ -203,30 +203,74 @@ kZeroBin = -4   # |v| <= kZeroThreshold on a MissingType.ZERO feature
 
 class StackedNodes(NamedTuple):
     """All T trees' node arrays, padded to common [T, NI] / [T, NL]
-    shapes (serving analogue of DeviceTree; serve/forest.py packs it)."""
-    feat: jnp.ndarray          # [T, NI] i32 REAL feature index
+    shapes (serving analogue of DeviceTree; serve/forest.py packs it).
+
+    Two encodings share this layout (serve/forest.py builds both):
+
+    - **compare nodes**: numeric decisions are ``bin <= tbin`` integer
+      compares, categorical ones LUT rows (``is_cat``/``cat_slot``);
+    - **LUT nodes**: EVERY node is a boolean LUT row over its feature's
+      bin space (``is_cat`` all-True, ``tbin`` all ``-1``) — one gather
+      decides the node, which cuts the walk's inner-loop op count on
+      wide sparse / EFB-bundled models (the "LUT node" encoding from
+      the sparse-oblique-forest direction; docs/SERVING.md)."""
+    feat: jnp.ndarray          # [T, NI] i32 COMPACT (used-feature) index
     tbin: jnp.ndarray          # [T, NI] i32 threshold rank (-1: none left)
     default_left: jnp.ndarray  # [T, NI] bool
     left: jnp.ndarray          # [T, NI] i32 (>=0 node, <0 ~leaf)
     right: jnp.ndarray         # [T, NI] i32
     is_cat: jnp.ndarray        # [T, NI] bool
-    cat_slot: jnp.ndarray      # [T, NI] i32 row of the shared cat LUT
+    cat_slot: jnp.ndarray      # [T, NI] i32 row of the shared LUT
     leaf_value: jnp.ndarray    # [T, NL] f32
 
 
 class QuantizerTables(NamedTuple):
-    """Per-feature raw-value→bin tables derived from the model's own
-    split thresholds (serve/forest.py builds them; exact in f32)."""
-    thresholds: jnp.ndarray    # [F, M] f32 round-down thresholds, +inf pad
-    is_cat: jnp.ndarray        # [F] bool
-    nan_feat: jnp.ndarray      # [F] bool (MissingType.NAN features)
-    zero_feat: jnp.ndarray     # [F] bool (MissingType.ZERO features)
+    """Per-USED-feature raw-value→bin tables derived from the model's
+    own split thresholds (serve/forest.py builds them; exact in f32).
+    ``used`` maps the compacted table rows back to raw row columns, so
+    the bins matrix the walk gathers from is [n, U] with U = #features
+    the forest actually splits on — the gather-width cut for wide
+    sparse models."""
+    used: jnp.ndarray          # [U] i32 raw column of each table row
+    thresholds: jnp.ndarray    # [U, M] f32 round-down thresholds, +inf pad
+    is_cat: jnp.ndarray        # [U] bool
+    nan_feat: jnp.ndarray      # [U] bool (MissingType.NAN features)
+    zero_feat: jnp.ndarray     # [U] bool (MissingType.ZERO features)
     vmax: jnp.ndarray          # [] i32 max categorical value in the LUT
     zero_eps: jnp.ndarray      # [] f32 round-down f32 of kZeroThreshold
 
 
+class QuantizerTablesDD(NamedTuple):
+    """Double-double quantizer tables for f64 request rows: each f64
+    threshold t is the exact pair (round-down-f32(t), integer residual
+    rank) — see ``serve/forest.py encode_dd`` for the row-side encoding
+    and the exactness argument."""
+    used: jnp.ndarray          # [U] i32 raw column of each table row
+    thr_hi: jnp.ndarray        # [U, M64] f32 round-down f32(t), +inf pad
+    thr_lo: jnp.ndarray        # [U, M64] i32 exact residual rank, 0 pad
+    is_cat: jnp.ndarray        # [U] bool
+    nan_feat: jnp.ndarray      # [U] bool
+    zero_feat: jnp.ndarray     # [U] bool
+    vmax: jnp.ndarray          # [] i32
+
+
+class LinearLeaves(NamedTuple):
+    """Linear-leaf (``linear_tree``) models packed into stacked arrays:
+    per leaf a constant + up-to-C coefficients over RAW feature columns
+    (the leaf's root-path features). ``valid`` masks the padding lanes
+    so a NaN in an unused pad column can never poison the NaN-fallback
+    check (host semantics: any NaN among the leaf's fitted features →
+    constant ``leaf_value`` fallback, models/linear.py)."""
+    const: jnp.ndarray         # [T, NL] f32
+    coeff: jnp.ndarray         # [T, NL, C] f32 (0 pad)
+    feat: jnp.ndarray          # [T, NL, C] i32 RAW feature column (0 pad)
+    valid: jnp.ndarray         # [T, NL, C] bool
+    has: jnp.ndarray           # [T, NL] bool (a linear fit exists)
+
+
 def _quantize_rows_impl(X: jnp.ndarray, qt: QuantizerTables) -> jnp.ndarray:
-    """[n, F] raw f32 rows → [n, F] i32 model-space bins.
+    """[n, F] raw f32 rows → [n, U] i32 model-space bins over the used
+    feature columns.
 
     Numeric bin = #{thresholds on f < v} — so ``bin <= rank(t)`` decides
     exactly like the host's ``v <= t`` (thresholds are stored as the
@@ -234,6 +278,7 @@ def _quantize_rows_impl(X: jnp.ndarray, qt: QuantizerTables) -> jnp.ndarray:
     f32-representable values). NaN/zero missing semantics are resolved
     here once per row, into sentinel bins the walk maps to default_left.
     """
+    X = jnp.take(X, qt.used, axis=1)
     isnan = jnp.isnan(X)
     # NaN behaves as 0.0 except on MissingType.NAN features (tree.py
     # _decide: v = where(isnan & missing != NAN, 0, fval))
@@ -254,11 +299,53 @@ def _quantize_rows_impl(X: jnp.ndarray, qt: QuantizerTables) -> jnp.ndarray:
     return jnp.where(qt.is_cat[None, :], cb, b)
 
 
+def _quantize_rows_dd_impl(Xhi: jnp.ndarray, Xlo: jnp.ndarray,
+                           qt: QuantizerTablesDD) -> jnp.ndarray:
+    """[n, F] double-double rows → [n, U] i32 bins in the model's f64
+    threshold grid. The host encoder (serve/forest.py ``encode_dd``)
+    already resolved NaN-as-zero and zero-as-missing semantics, so here
+    a bin is a lexicographic pair count:
+
+        bin = #{j : (thr_hi_j, thr_lo_j) < (hi, lo)}
+
+    which is EXACTLY #{t_j < v} because the pair encoding is monotone
+    and exact for every f64 whose f32 round-down is a normal float.
+    The encoder preserves NaN in ``hi`` everywhere (so linear-leaf
+    NaN-fallback masks still see it); NaN-as-zero on non-NaN-missing
+    numeric features substitutes the exact (0, 0) pair here."""
+    Xhi = jnp.take(Xhi, qt.used, axis=1)
+    Xlo = jnp.take(Xlo, qt.used, axis=1)
+    isnan = jnp.isnan(Xhi)
+    as_zero = isnan & ~qt.nan_feat[None, :]
+    hi = jnp.where(as_zero, jnp.float32(0.0), Xhi)[:, :, None]
+    lo = jnp.where(as_zero, jnp.int32(0), Xlo)[:, :, None]
+    thi = qt.thr_hi[None, :, :]
+    tlo = qt.thr_lo[None, :, :]
+    less = (thi < hi) | ((thi == hi) & (tlo < lo))
+    b = jnp.sum(less, axis=2).astype(jnp.int32)
+    b = jnp.where(qt.nan_feat[None, :] & isnan, jnp.int32(kNanBin), b)
+    # zero-as-missing rides the encoder's lo == -1 sentinel (the f64
+    # |v| <= kZeroThreshold test is exact on host, not re-derivable
+    # from the pair)
+    b = jnp.where(qt.zero_feat[None, :] & (Xlo == -1),
+                  jnp.int32(kZeroBin), b)
+    vmax = qt.vmax.astype(jnp.float32)
+    iv = jnp.clip(jnp.where(isnan, jnp.float32(-1.0), Xhi),
+                  -1.0, vmax + 1.0).astype(jnp.int32)
+    cb = jnp.where((iv >= 0) & (iv <= qt.vmax), iv, qt.vmax + 1)
+    return jnp.where(qt.is_cat[None, :], cb, b)
+
+
 def _walk_stacked(bins: jnp.ndarray, nodes: StackedNodes,
                   cat_lut: jnp.ndarray, trips: int) -> jnp.ndarray:
-    """[n, F] bins → [T, n] leaf ids: the DeviceTree lockstep walk,
-    vmapped over the stacked tree axis."""
+    """[n, U] bins → [T, n] leaf ids: the DeviceTree lockstep walk,
+    vmapped over the stacked tree axis. The LUT always reserves its two
+    last columns for the NaN/zero sentinel bins, so LUT-encoded nodes
+    resolve default_left with the same single gather that decides the
+    split (compare-encoded categorical nodes never receive sentinels —
+    the pad columns are dead for them)."""
     n = bins.shape[0]
+    lut_w = cat_lut.shape[1]
 
     def walk_one(feat, tbin, dl, left, right, is_cat, cat_slot):
         def body(_, node):
@@ -268,7 +355,10 @@ def _walk_stacked(bins: jnp.ndarray, nodes: StackedNodes,
             gl = b <= tbin[nd]
             gl = jnp.where(b == kNanBin, dl[nd], gl)
             gl = jnp.where(b == kZeroBin, dl[nd], gl)
-            lu = cat_lut[cat_slot[nd], jnp.maximum(b, 0)]
+            bi = jnp.where(b == kNanBin, lut_w - 2,
+                           jnp.where(b == kZeroBin, lut_w - 1,
+                                     jnp.maximum(b, 0)))
+            lu = cat_lut[cat_slot[nd], bi]
             gl = jnp.where(is_cat[nd], lu, gl)
             nxt = jnp.where(gl, left[nd], right[nd])
             return jnp.where(node >= 0, nxt, node)
@@ -282,13 +372,28 @@ def _walk_stacked(bins: jnp.ndarray, nodes: StackedNodes,
                               nodes.cat_slot)
 
 
-def _stacked_leaves_body(X, qt, nodes, cat_lut, trips):
-    return _walk_stacked(_quantize_rows_impl(X, qt), nodes, cat_lut, trips)
+def _linear_leaf_values(X, leaves, vals, lin: LinearLeaves):
+    """Override stacked leaf values with each leaf's linear model where
+    one exists and none of its fitted features is NaN (f32 device math —
+    the throughput path; the bit-exact host path accumulates linear
+    values in f64 from the same device leaf ids)."""
+    def lin_one(leaf_t, val_t, const_t, coeff_t, feat_t, valid_t, has_t):
+        f = feat_t[leaf_t]                                   # [n, C]
+        xv = jnp.take_along_axis(X, f, axis=1)               # [n, C]
+        v = valid_t[leaf_t]
+        bad = jnp.any(jnp.isnan(xv) & v, axis=1)
+        s = const_t[leaf_t] + jnp.sum(
+            jnp.where(v, coeff_t[leaf_t] * xv, jnp.float32(0.0)), axis=1)
+        return jnp.where(has_t[leaf_t] & ~bad, s, val_t)
+
+    return jax.vmap(lin_one)(leaves, vals, lin.const, lin.coeff,
+                             lin.feat, lin.valid, lin.has)
 
 
-def _stacked_raw_body(X, qt, nodes, cat_lut, trips, K):
-    leaves = _stacked_leaves_body(X, qt, nodes, cat_lut, trips)
+def _raw_from_leaves(X, leaves, nodes, K, lin):
     vals = jnp.take_along_axis(nodes.leaf_value, leaves, axis=1)  # [T, n]
+    if lin is not None:
+        vals = _linear_leaf_values(X, leaves, vals, lin)
     # models are iteration-major: tree i contributes to class i % K.
     # Per-class Kahan-compensated f32 sum over the iteration axis: the
     # compensation term recovers the low-order bits a plain f32 sum
@@ -310,21 +415,50 @@ def _stacked_raw_body(X, qt, nodes, cat_lut, trips, K):
     return total.T                                                # [n, K]
 
 
+def _stacked_leaves_body(X, qt, nodes, cat_lut, trips):
+    return _walk_stacked(_quantize_rows_impl(X, qt), nodes, cat_lut, trips)
+
+
+def _stacked_raw_body(X, qt, nodes, cat_lut, trips, K, lin=None):
+    leaves = _stacked_leaves_body(X, qt, nodes, cat_lut, trips)
+    return _raw_from_leaves(X, leaves, nodes, K, lin)
+
+
+def _stacked_leaves_dd_body(Xhi, Xlo, qt, nodes, cat_lut, trips):
+    return _walk_stacked(_quantize_rows_dd_impl(Xhi, Xlo, qt), nodes,
+                         cat_lut, trips)
+
+
+def _stacked_raw_dd_body(Xhi, Xlo, qt, nodes, cat_lut, trips, K,
+                         lin=None):
+    leaves = _stacked_leaves_dd_body(Xhi, Xlo, qt, nodes, cat_lut, trips)
+    return _raw_from_leaves(Xhi, leaves, nodes, K, lin)
+
+
 def _make_stacked_jits():
     """Jitted quantize+walk entry points, trace-tracked through
     obs/compile.py (one compile per (row-bucket, forest-shape); the
     serve cache pads rows so a second dispatch at the same bucket hits
-    the jit cache with zero retraces)."""
+    the jit cache with zero retraces — and replicas placing the SAME
+    forest shapes on N devices share these traces too, so a fleet
+    traces once per shape bucket, not once per device)."""
     leaves = obs_compile.instrument_jit(
         "serve.stacked_leaves", _stacked_leaves_body,
         static_argnames=("trips",))
     raw = obs_compile.instrument_jit(
         "serve.stacked_raw", _stacked_raw_body,
         static_argnames=("trips", "K"))
-    return leaves, raw
+    leaves_dd = obs_compile.instrument_jit(
+        "serve.stacked_leaves_dd", _stacked_leaves_dd_body,
+        static_argnames=("trips",))
+    raw_dd = obs_compile.instrument_jit(
+        "serve.stacked_raw_dd", _stacked_raw_dd_body,
+        static_argnames=("trips", "K"))
+    return leaves, raw, leaves_dd, raw_dd
 
 
-stacked_forest_leaves, stacked_forest_raw = _make_stacked_jits()
+(stacked_forest_leaves, stacked_forest_raw,
+ stacked_forest_leaves_dd, stacked_forest_raw_dd) = _make_stacked_jits()
 
 
 def _gather_leaf_values_body(leaf_value, leaf):

@@ -13,11 +13,21 @@ error catalog.
 
 >>> from lightgbm_tpu.serve import PredictServer, StackedForest
 >>> forest = StackedForest.from_gbdt(booster)     # or a Booster directly
->>> server = PredictServer(forest, max_batch=256, max_queue_rows=4096)
+>>> server = PredictServer(forest, max_batch=256, max_queue_rows=4096,
+...                        replicas="auto")       # one replica per device
 >>> server.predict(row, deadline_ms=50)           # coalesced micro-batch
+
+``replicas="auto"`` replicates the forest per device and shards the
+micro-batch queue across the mesh: admission control, deadlines, the
+breaker, and canary rollback stay GLOBAL; dispatch capacity scales with
+device count. Linear-leaf models, EFB-style wide sparse models (LUT
+nodes + used-feature-compacted gathers), and f64 batches (double-double
+encoding) all take the device fast path — no host-walk fallbacks.
 """
 from .cache import BucketedPredictor  # noqa: F401
 from .forest import StackedForest, round_down_f32  # noqa: F401
+from .replicate import (ReplicatedForest,  # noqa: F401
+                        compile_predict_with_plan)
 from .server import (BreakerOpen, CircuitBreaker,  # noqa: F401
                      DeadlineExceeded, ModelRegistry, Overloaded,
                      PredictServer, ServeError, ShuttingDown)
@@ -25,4 +35,5 @@ from .server import (BreakerOpen, CircuitBreaker,  # noqa: F401
 __all__ = ["StackedForest", "BucketedPredictor", "ModelRegistry",
            "PredictServer", "round_down_f32", "ServeError", "Overloaded",
            "DeadlineExceeded", "ShuttingDown", "BreakerOpen",
-           "CircuitBreaker"]
+           "CircuitBreaker", "ReplicatedForest",
+           "compile_predict_with_plan"]

@@ -8,15 +8,22 @@ The cache quantizes incoming batches onto power-of-two row buckets
 pad back off, so the whole serving lifetime of a model version compiles
 at most ``log2(max_bucket / min_bucket) + 1`` variants per output kind.
 
-Entries are keyed ``(model_version, bucket, output_kind)``. The jitted
-executables themselves live in jax's jit cache (keyed by array shapes,
-so two model versions with equal packed shapes share compilations);
-this layer tracks the bucket policy: which keys exist, hit/compile
-counts (``serve/bucket_hit`` / ``serve/bucket_compile`` counters and the
-``serve/compile_cache_size`` gauge), while retraces stay attributable
-per jit function through obs/compile.py (``serve.stacked_leaves`` /
-``serve.stacked_raw``).
-"""
+Entries are keyed ``(model_version, bucket, output_kind)`` (f64
+double-double dispatches append a ``"dd"`` marker — they run a separate
+program). The jitted executables themselves live in jax's jit cache
+(keyed by array shapes, so two model versions with equal packed shapes
+share compilations); this layer tracks the bucket policy: which keys
+exist, hit/compile counts (``serve/bucket_hit`` / ``serve/bucket_compile``
+counters and the ``serve/compile_cache_size`` gauge), while retraces stay
+attributable per jit function through obs/compile.py
+(``serve.stacked_leaves`` / ``serve.stacked_raw`` / ``..._dd``).
+
+A multi-replica server passes ONE ``entries`` dict to all its
+per-replica predictors: the bucket policy — and the Python-level traces
+behind it — is shared across the fleet, so N devices serving the same
+shape bucket keep the cache at single-replica size and add zero new
+traces (the per-device XLA executables still compile once per device,
+off the dispatch path)."""
 from __future__ import annotations
 
 from typing import Dict, Optional, Tuple
@@ -25,19 +32,30 @@ import numpy as np
 
 from ..obs.registry import registry as obs
 from ..utils import next_pow2
-from .forest import StackedForest
+from .forest import StackedForest, f32_exact
 
 _KINDS = ("value", "raw", "leaf", "raw_device")
+
+# the dd quantizer's lexicographic pair count broadcasts an
+# [rows, used_features, thresholds] boolean compare before reducing —
+# bound dd chunks so that intermediate stays tens of MB, not GB, even
+# when a caller pushes a huge f64 batch through a 64k max_bucket
+kDDBucketCap = 4096
 
 
 class BucketedPredictor:
     """Pads batches to power-of-two row buckets around a StackedForest;
     ``swap`` replaces the forest for hot model upgrades (the bucket
-    policy and stats survive the swap)."""
+    policy and stats survive the swap). Pass a shared ``entries`` dict
+    to make several predictors (one per replica) share one bucket
+    policy."""
 
     def __init__(self, forest: StackedForest, model_version=0,
                  min_bucket: int = 16, max_bucket: int = 1 << 16,
-                 output_kind: str = "value"):
+                 output_kind: str = "value",
+                 entries: Optional[Dict[Tuple, int]] = None,
+                 entries_lock=None):
+        import threading
         if output_kind not in _KINDS:
             raise ValueError("output_kind must be one of %s" % (_KINDS,))
         self.forest = forest
@@ -45,62 +63,92 @@ class BucketedPredictor:
         self.min_bucket = max(int(min_bucket), 1)
         self.max_bucket = max(int(max_bucket), self.min_bucket)
         self.output_kind = output_kind
-        # (model_version, bucket, kind) -> dispatch count
-        self.entries: Dict[Tuple, int] = {}
+        # (model_version, bucket, kind[, "dd"]) -> dispatch count.
+        # When `entries` is shared across replica dispatch threads the
+        # caller passes ONE `entries_lock` too: insert/increment/purge
+        # are read-modify-write and iterate-while-mutating hazards
+        self.entries: Dict[Tuple, int] = \
+            entries if entries is not None else {}
+        self._entries_lock = (entries_lock if entries_lock is not None
+                              else threading.Lock())
 
-    def swap(self, forest: StackedForest, model_version) -> None:
+    def swap(self, forest: StackedForest, model_version,
+             keep_versions=None) -> None:
+        """Swap the served forest. Keys of versions outside
+        ``keep_versions`` (default: just the new version) purge from
+        ``entries`` IN PLACE — a multi-replica server passes the set of
+        versions still live on its OTHER replicas (a pinned canary
+        leaves replica 0 on a different version than the rest for the
+        whole window), so a swap never evicts a sibling's hot keys."""
         self.forest = forest
         self.model_version = model_version
-        # drop the replaced version's keys: a hot-swapping server must
-        # not grow `entries` (and the cache-size gauge) without bound
-        self.entries = {k: v for k, v in self.entries.items()
-                        if k[0] == model_version}
-        obs.gauge("serve/compile_cache_size", len(self.entries))
+        keep = set(keep_versions) if keep_versions is not None else set()
+        keep.add(model_version)
+        with self._entries_lock:
+            for k in [k for k in self.entries if k[0] not in keep]:
+                self.entries.pop(k, None)
+            size = len(self.entries)
+        obs.gauge("serve/compile_cache_size", size)
 
     def bucket_for(self, n_rows: int) -> int:
         return min(next_pow2(max(n_rows, self.min_bucket)),
                    self.max_bucket)
 
     # ------------------------------------------------------------------
-    def _dispatch(self, kind: str, X: np.ndarray):
+    def _dispatch(self, kind: str, X: np.ndarray, dd: bool):
+        # the dd decision was made ONCE for the whole batch: pass it
+        # down so a chunk whose rows happen to be f32-exact cannot
+        # dispatch a different program than its bucket key claims
         if kind == "value":
-            return self.forest.predict(X)
+            return self.forest.predict(X, dd=dd)
         if kind == "raw":
-            return self.forest.predict_raw(X)
+            return self.forest.predict_raw(X, dd=dd)
         if kind == "leaf":
-            return self.forest.leaves(X)
+            return self.forest.leaves(X, dd=dd)
         import jax
         # jaxlint: disable=JLT001 -- serving boundary: the f32 device
         # sum comes home exactly once per dispatch, by design
-        return jax.device_get(self.forest.predict_raw_device(X))
+        return jax.device_get(self.forest.predict_raw_device(X, dd=dd))
 
     def predict(self, X, output_kind: Optional[str] = None) -> np.ndarray:
         """Predict with bucket padding; batches larger than
-        ``max_bucket`` stream through in max-bucket chunks."""
+        ``max_bucket`` stream through in max-bucket chunks. f64 batches
+        the f32 quantizer cannot represent exactly keep their dtype and
+        dispatch the double-double program (separate bucket keys);
+        everything else downcasts to f32 exactly."""
         kind = output_kind or self.output_kind
         if kind not in _KINDS:
             raise ValueError("output_kind must be one of %s" % (_KINDS,))
         X = np.asarray(X)
         if X.ndim == 1:
             X = X.reshape(1, -1)
+        dd = X.dtype == np.float64 and not f32_exact(X)
+        if not dd:
+            X = np.ascontiguousarray(X, dtype=np.float32)
         n = X.shape[0]
+        max_chunk = (min(self.max_bucket, kDDBucketCap) if dd
+                     else self.max_bucket)
         outs = []
-        for lo in range(0, max(n, 1), self.max_bucket):
-            chunk = X[lo:lo + self.max_bucket]
+        for lo in range(0, max(n, 1), max_chunk):
+            chunk = X[lo:lo + max_chunk]
             m = chunk.shape[0]
-            bucket = self.bucket_for(m)
+            bucket = min(self.bucket_for(m), max_chunk)
             if m < bucket:
                 pad = np.zeros((bucket - m, X.shape[1]), dtype=X.dtype)
                 chunk = np.concatenate([chunk, pad], axis=0)
             key = (self.model_version, bucket, kind)
-            if key not in self.entries:
-                self.entries[key] = 0
+            if dd:
+                key += ("dd",)
+            with self._entries_lock:
+                fresh = key not in self.entries
+                self.entries[key] = self.entries.get(key, 0) + 1
+                size = len(self.entries)
+            if fresh:
                 obs.inc("serve/bucket_compile")
-                obs.gauge("serve/compile_cache_size", len(self.entries))
+                obs.gauge("serve/compile_cache_size", size)
             else:
                 obs.inc("serve/bucket_hit")
-            self.entries[key] += 1
-            outs.append(self._dispatch(kind, chunk)[:m])
+            outs.append(self._dispatch(kind, chunk, dd)[:m])
         return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
 
     # ------------------------------------------------------------------

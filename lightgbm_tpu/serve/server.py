@@ -22,6 +22,21 @@ requests cost ceil(N / max_batch) dispatches, not N. A request larger
 than ``max_batch`` is split across dispatches and its Future's result
 reassembled (the predictor never sees a batch past its bucket cap).
 
+``replicas=N`` (or ``"auto"`` = one per jax device) turns the server
+into a mesh-replicated fleet: the forest's stacked arrays are PLACED on
+each replica's device (``StackedForest.place``; one transfer, cached per
+device) and N dispatch workers drain the ONE admission queue — so
+shedding, deadlines, the breaker, and drain stay global while dispatch
+capacity scales with device count. All replicas share one shape-bucket
+compile cache and the module-level jitted programs (same array shapes →
+zero extra Python traces per replica). Canary routing is pinned to
+replica 0, so a canary window's outcomes are evaluated sequentially and
+rollback semantics are identical to the single-replica server; the
+other replicas serve the stable version throughout the window.
+Per-replica latency histograms (``serve/latency_ms/replica/<k>``) and
+dispatch counters merge into the serve summary via ``replica_stats()``
+and export as ``{replica="k"}``-labeled series (obs/export.py).
+
 The serving plane is fail-closed under overload (docs/SERVING.md has
 the full semantics + typed error catalog):
 
@@ -327,12 +342,16 @@ class ModelRegistry:
                 raise KeyError("no model published under %r" % name)
             return self._models[name]
 
-    def route(self, name: str = "default"):
+    def route(self, name: str = "default", canary_ok: bool = True):
         """(version, forest, is_canary) the next dispatch should use:
-        the canary while its window is open, else the stable version."""
+        the canary while its window is open, else the stable version.
+        ``canary_ok=False`` always routes stable — a multi-replica
+        server PINS the canary to one replica (replica 0), so the
+        window's dispatch outcomes stay sequential and rollback
+        semantics are identical to the single-replica server."""
         with self._lock:
             c = self._canary.get(name)
-            if c is not None:
+            if c is not None and canary_ok:
                 return c["version"], c["forest"], True
             if name not in self._models:
                 raise KeyError("no model published under %r" % name)
@@ -499,7 +518,8 @@ class PredictServer:
                  block_timeout_ms: float = 1000.0,
                  default_deadline_ms: Optional[float] = None,
                  breaker_threshold: int = 5,
-                 breaker_cooldown_ms: float = 2000.0):
+                 breaker_cooldown_ms: float = 2000.0,
+                 replicas=1):
         if isinstance(model, ModelRegistry):
             self.registry = model
         else:
@@ -522,12 +542,32 @@ class PredictServer:
                                       breaker_cooldown_ms / 1e3,
                                       model=name)
         version, forest = self.registry.get(name)
-        self.predictor = BucketedPredictor(
-            forest, model_version=version, min_bucket=min_bucket,
-            max_bucket=max(next_pow2(self.max_batch), min_bucket),
-            output_kind=output_kind)
+        # --- replica fleet: one forest placement + one dispatch worker
+        # per device; admission (queue/shedding/deadlines), the breaker,
+        # and canary accounting stay GLOBAL so overload and rollback
+        # semantics are unchanged — only dispatch capacity scales
+        import jax
+        devices = jax.devices()
+        if replicas in ("auto", 0, None):
+            replicas = len(devices)
+        self.replicas = max(int(replicas), 1)
+        self._devices = [devices[k % len(devices)]
+                         for k in range(self.replicas)]
+        mb = max(next_pow2(self.max_batch), min_bucket)
+        shared_entries: Dict = {}
+        shared_entries_lock = threading.Lock()
+        if self.replicas == 1:
+            placed = [forest]  # single replica: follow the default device
+        else:
+            placed = [forest.place(d) for d in self._devices]
+        self.predictors = [BucketedPredictor(
+            placed[k], model_version=version, min_bucket=min_bucket,
+            max_bucket=mb, output_kind=output_kind,
+            entries=shared_entries, entries_lock=shared_entries_lock)
+            for k in range(self.replicas)]
+        self.predictor = self.predictors[0]
+        obs.gauge("serve/replicas", self.replicas)
         if require_backend is not None:
-            import jax
             actual = jax.default_backend()
             if actual != require_backend:
                 obs_health.record_backend_fallback(
@@ -539,8 +579,9 @@ class PredictServer:
         self._cond = threading.Condition()
         self._stop = False
         self._stopped = False
-        self._inflight: List[_Request] = []
+        self._inflight: Dict[int, List[_Request]] = {}
         self._thread: Optional[threading.Thread] = None
+        self._threads: List[threading.Thread] = []
         self.stats = {"dispatches": 0, "requests": 0, "rows": 0,
                       "shed": 0, "expired": 0}
         self._next_watch = 0.0
@@ -577,12 +618,28 @@ class PredictServer:
         return "ready"
 
     def start(self) -> "PredictServer":
-        if self._thread is None or not self._thread.is_alive():
+        """Start (or repair) the dispatch worker fleet: every replica
+        whose worker is missing or dead gets a fresh thread — a fleet
+        with ONE dead worker must be healable, not only a fully-dead
+        one (the single-worker server restarted its only thread; N>1
+        keeps that property per replica)."""
+        if self._stopped or not self._threads \
+                or not all(t.is_alive() for t in self._threads):
             self._stop = False
             self._stopped = False
-            self._thread = threading.Thread(
-                target=self._run, name="lightgbm-tpu-serve", daemon=True)
-            self._thread.start()
+            threads = list(self._threads) + \
+                [None] * (self.replicas - len(self._threads))
+            for k in range(self.replicas):
+                if threads[k] is not None and threads[k].is_alive():
+                    continue
+                name = ("lightgbm-tpu-serve" if k == 0
+                        else "lightgbm-tpu-serve-%d" % k)
+                t = threading.Thread(target=self._run, args=(k,),
+                                     name=name, daemon=True)
+                t.start()
+                threads[k] = t
+            self._threads = threads
+            self._thread = self._threads[0]
         return self
 
     def stop(self, drain_timeout_s: float = 30.0) -> None:
@@ -595,8 +652,10 @@ class PredictServer:
         with self._cond:
             self._stop = True
             self._cond.notify_all()
-        if self._thread is not None and self._thread.is_alive():
-            self._thread.join(timeout=max(float(drain_timeout_s), 0.0))
+        limit = time.perf_counter() + max(float(drain_timeout_s), 0.0)
+        for t in self._threads:
+            if t.is_alive():
+                t.join(timeout=max(limit - time.perf_counter(), 0.0))
         stranded: List[_Request] = []
         seen_asm = set()
 
@@ -617,9 +676,10 @@ class PredictServer:
             while self._queue:
                 _strand(self._queue.popleft())
             self._pending_rows = 0
-            for r in self._inflight:
-                _strand(r)
-            self._inflight = []
+            for batch in self._inflight.values():
+                for r in batch:
+                    _strand(r)
+            self._inflight = {}
             obs.gauge("serve/queue_depth", 0)
             self._stopped = True
         if stranded:
@@ -644,7 +704,18 @@ class PredictServer:
         all resolve it with a typed :class:`ServeError`. Malformed
         requests still raise here — a shape bug is a caller bug, not
         an overload condition."""
-        x = np.asarray(x, dtype=np.float32)
+        x = np.asarray(x)
+        # f64 requests that actually EXCEED f32 precision keep their
+        # dtype: the predictor serves them exactly through the
+        # double-double device path. f32-exact f64 blocks downcast here
+        # losslessly (so they coalesce with f32 traffic instead of
+        # dragging a whole batch onto the slower dd program); everything
+        # else is the f32 serving contract
+        from .forest import f32_exact
+        if x.dtype == np.float64 and not f32_exact(x):
+            x = x.astype(np.float64, copy=False)
+        else:
+            x = x.astype(np.float32)
         single = x.ndim == 1
         if x.ndim not in (1, 2):
             raise ValueError("submit takes a [F] row or an [m, F] block")
@@ -749,7 +820,10 @@ class PredictServer:
                 self._queue.extend(reqs)
                 self._pending_rows += rows
                 obs.gauge("serve/queue_depth", self._pending_rows)
-                self._cond.notify()
+                # notify_all: workers and backpressured submitters share
+                # this condition — a single notify could wake a blocked
+                # submitter while every dispatch worker keeps sleeping
+                self._cond.notify_all()
         if shed_reason is not None:
             # shed accounting OUTSIDE the lock: the flushed event does
             # file I/O, and overload is exactly when the worker and
@@ -762,6 +836,22 @@ class PredictServer:
         """Synchronous convenience wrapper around ``submit``."""
         return self.submit(x, deadline_ms=deadline_ms).result(
             timeout=timeout)
+
+    def warm(self, x) -> None:
+        """Dispatch ``x`` through EVERY replica's predictor directly
+        (bypassing the queue): Python traces are shared across the
+        fleet, but XLA still compiles one executable per device — this
+        pays that cost for x's shape bucket up front so a fresh fleet
+        never compiles mid-traffic. Pass a true-f64 block to pre-warm
+        the double-double program's buckets too (the dtype is
+        preserved, same as ``submit``)."""
+        x = np.asarray(x)
+        x = x.astype(np.float64 if x.dtype == np.float64 else np.float32,
+                     copy=False)
+        if x.ndim == 1:
+            x = x.reshape(1, -1)
+        for p in self.predictors:
+            p.predict(x)
 
     def _shed(self, future: Future, rows: int, reason: str,
               queue_rows: int) -> Future:
@@ -802,6 +892,12 @@ class PredictServer:
                     nxt = self._queue[0]
                     if batch and rows + nxt.rows > self.max_batch:
                         break  # next request overflows: next dispatch
+                    if batch and nxt.x.dtype != batch[0].x.dtype:
+                        # keep batches dtype-homogeneous: one true-f64
+                        # request must not drag coalesced f32 traffic
+                        # onto the chunked dd program (the f64 rows
+                        # dispatch in the NEXT batch)
+                        break
                     self._queue.popleft()
                     self._pending_rows -= nxt.rows
                     if nxt.assembly is not None and nxt.assembly.dead:
@@ -836,14 +932,14 @@ class PredictServer:
         else:
             _fail_future(req.future, exc)
 
-    def _run(self) -> None:
+    def _run(self, replica: int = 0) -> None:
         while True:
             batch = self._take_batch()
             if not batch:
                 if self._stop and not self._queue:
                     return
                 continue
-            self._dispatch(batch)
+            self._dispatch(batch, replica)
 
     def _fail_batch(self, batch: List[_Request],
                     exc: BaseException) -> None:
@@ -853,20 +949,21 @@ class PredictServer:
             else:
                 _fail_future(r.future, exc)
 
-    def _predict_guarded(self, X: np.ndarray, version, canary: bool):
+    def _predict_guarded(self, X: np.ndarray, version, canary: bool,
+                         predictor: BucketedPredictor):
         """One faultable dispatch. During a canary window the output is
         additionally screened for non-finite values — a numerically
         poisoned model must not survive its canary."""
         obs_faults.check("serve_dispatch", model=self.name,
                          version=version)
         with obs.scope("serve::predict_batch"):
-            y = self.predictor.predict(X)
+            y = predictor.predict(X)
         if canary and not np.all(np.isfinite(y)):
             raise FloatingPointError(
                 "canary v%s produced non-finite predictions" % version)
         return y
 
-    def _dispatch(self, batch) -> None:
+    def _dispatch(self, batch, replica: int = 0) -> None:
         # claim every future first: a client-cancelled Future must drop
         # out here — set_result on it would raise InvalidStateError and
         # kill the worker (then every later submit hangs forever)
@@ -882,9 +979,9 @@ class PredictServer:
         if not batch:
             return
         with self._cond:
-            self._inflight = batch
+            self._inflight[replica] = batch
         try:
-            self._dispatch_claimed(batch)
+            self._dispatch_claimed(batch, replica)
         except Exception as e:  # noqa: BLE001 — NOTHING in a dispatch
             # may kill the worker (every later submit would hang):
             # failures outside the guarded predict (routing, swap,
@@ -894,20 +991,42 @@ class PredictServer:
             self.breaker.record_failure(e)
         finally:
             with self._cond:
-                self._inflight = []
+                self._inflight.pop(replica, None)
 
-    def _dispatch_claimed(self, batch) -> None:
+    def _swap_placed(self, predictor: BucketedPredictor, forest,
+                     version, replica: int) -> None:
+        """Swap a replica's predictor to a new version, placing the
+        forest's arrays on the replica's own device (placements are
+        cached per device on the forest, so N replicas sharing a device
+        — or re-swapping — pay the transfer once). The shared entries
+        dict keeps every version still live on a sibling replica — a
+        pinned canary leaves replica 0 on a different version than the
+        rest for the whole window, and its swap must not evict their
+        hot keys."""
+        if self.replicas > 1:
+            forest = forest.place(self._devices[replica])
+        predictor.swap(forest, version,
+                       keep_versions=[p.model_version
+                                      for p in self.predictors])
+
+    def _dispatch_claimed(self, batch, replica: int = 0) -> None:
         rows = sum(r.rows for r in batch)
+        predictor = self.predictors[replica]
         # hot swap / canary routing: pick up the latest published
-        # (or canary) version between dispatches, never mid-batch
-        version, forest, canary = self.registry.route(self.name)
-        if version != self.predictor.model_version:
-            self.predictor.swap(forest, version)
+        # (or canary) version between dispatches, never mid-batch.
+        # Canary routing is PINNED to replica 0 — the other replicas
+        # keep serving the stable version during the window, so canary
+        # outcome accounting stays sequential (single-replica
+        # semantics) while the fleet keeps its capacity
+        version, forest, canary = self.registry.route(
+            self.name, canary_ok=replica == 0)
+        if version != predictor.model_version:
+            self._swap_placed(predictor, forest, version, replica)
         X = (batch[0].x if len(batch) == 1
              else np.concatenate([r.x for r in batch], axis=0))
         t0 = time.perf_counter()
         try:
-            y = self._predict_guarded(X, version, canary)
+            y = self._predict_guarded(X, version, canary, predictor)
         except Exception as e:  # noqa: BLE001 — a bad batch must
             #                     not kill the worker
             rolled = False
@@ -923,10 +1042,10 @@ class PredictServer:
             # serving: replay this batch on it — admitted requests
             # must not pay for a poisoned canary
             version, forest, _ = self.registry.route(self.name)
-            self.predictor.swap(forest, version)
+            self._swap_placed(predictor, forest, version, replica)
             canary = False
             try:
-                y = self._predict_guarded(X, version, False)
+                y = self._predict_guarded(X, version, False, predictor)
             except Exception as e2:  # noqa: BLE001
                 self._fail_batch(batch, e2)
                 self.breaker.record_failure(e2)
@@ -937,11 +1056,17 @@ class PredictServer:
             self.registry.canary_result(self.name, version, ok=True)
         now = time.perf_counter()
         lo = 0
+        # per-replica AND per-model series (two servers in one process
+        # must not clobber each other — the PR 10 breaker-gauge lesson);
+        # obs/export.py folds the suffix into {replica=,model=} labels
+        suffix = "/replica/%d/model/%s" % (replica, self.name)
+        rep_hist = "serve/latency_ms" + suffix
         for r in batch:
             part = y[lo:lo + r.rows]
             lo += r.rows
             obs.observe("serve/latency_ms",
                         (now - r.t_submit) * 1e3)
+            obs.observe(rep_hist, (now - r.t_submit) * 1e3)
             if r.assembly is not None:
                 r.assembly.complete(r.offset, part)
             else:
@@ -949,12 +1074,16 @@ class PredictServer:
                     r.future.set_result(part[0] if r.single else part)
                 except Exception:
                     pass  # stop()'s drain-timeout failure raced us
-        self.stats["dispatches"] += 1
-        # caller requests, not split chunks: chunk 0 stands for its
-        # whole oversized request (matches the serve/requests counter)
-        self.stats["requests"] += sum(
-            1 for r in batch if r.assembly is None or r.offset == 0)
-        self.stats["rows"] += rows
+        obs.inc("serve/dispatches" + suffix)
+        obs.inc("serve/rows" + suffix, rows)
+        with self._cond:  # N workers: stats += is read-modify-write
+            self.stats["dispatches"] += 1
+            # caller requests, not split chunks: chunk 0 stands for its
+            # whole oversized request (matches the serve/requests
+            # counter)
+            self.stats["requests"] += sum(
+                1 for r in batch if r.assembly is None or r.offset == 0)
+            self.stats["rows"] += rows
         if self.watchdog is not None and now >= self._next_watch:
             # SLO rules over the live registry at most ~1 Hz (a full
             # snapshot per dispatch would cost more than the dispatch)
@@ -962,9 +1091,9 @@ class PredictServer:
             self.watchdog.evaluate()
         obs_events.emit(
             "predict_batch", model=self.name,
-            version=self.predictor.model_version,
+            version=predictor.model_version, replica=replica,
             n_requests=len(batch), rows=rows,
-            bucket=self.predictor.bucket_for(
+            bucket=predictor.bucket_for(
                 min(rows, self.max_batch)),
             seconds=round(dt, 6))
 
@@ -972,3 +1101,22 @@ class PredictServer:
     def latency_percentiles(self) -> Dict[str, float]:
         return {"p50": obs.percentile("serve/latency_ms", 50.0),
                 "p99": obs.percentile("serve/latency_ms", 99.0)}
+
+    def replica_stats(self) -> Dict[int, Dict[str, float]]:
+        """Per-replica dispatch/row counters + latency percentiles, the
+        merge the serve summary and ``bench.py serve`` report (each
+        replica also exports its own
+        ``serve/latency_ms{replica=,model=}`` series through
+        obs/export.py). The series are keyed by THIS server's model
+        name, so two servers in one process read their own numbers."""
+        out: Dict[int, Dict[str, float]] = {}
+        for k in range(self.replicas):
+            suffix = "/replica/%d/model/%s" % (k, self.name)
+            h = "serve/latency_ms" + suffix
+            out[k] = {
+                "dispatches": obs.count("serve/dispatches" + suffix),
+                "rows": obs.count("serve/rows" + suffix),
+                "p50_ms": obs.percentile(h, 50.0),
+                "p99_ms": obs.percentile(h, 99.0),
+            }
+        return out

@@ -134,17 +134,33 @@ def test_stacked_forest_start_num_iteration_slice(shared):
     assert np.array_equal(host, forest.predict(X))
 
 
-def test_stacked_forest_rejects_linear_trees():
+def test_stacked_forest_serves_linear_trees():
+    """Linear-leaf models pack their leaf_const/leaf_coeff into the
+    stacked arrays (ISSUE 11): the device fast path serves them with
+    the bit-exact host contract (device leaf ids + host f64 linear
+    accumulation) instead of declining to the host walk."""
     X, y = _data(n=200, seed=9, with_nan=False, with_cat=False)
     bst = lgb.train({"objective": "regression", "num_leaves": 7,
                      "verbosity": -1, "min_data_in_leaf": 20,
                      "max_bin": 63, "linear_tree": True},
                     lgb.Dataset(X, label=X[:, 0]), num_boost_round=2)
-    with pytest.raises(ValueError):
-        StackedForest.from_gbdt(bst)
-    # ... and the Booster fast path silently falls back to host
+    host = bst.predict(X, predict_on_device=False)
+    forest = StackedForest.from_gbdt(bst)
+    assert forest.has_linear
+    assert np.array_equal(host, forest.predict(X))
+    # NaN in a fitted leaf feature falls back to the constant leaf
+    # value exactly like the host (models/linear.py) does
+    Xn = X.copy()
+    Xn[::5, 0] = np.nan
+    assert np.array_equal(bst.predict(Xn, predict_on_device=False),
+                          forest.predict(Xn))
+    # ... and the Booster fast path now dispatches through the cache
+    base = registry.count("serve/bucket_compile") \
+        + registry.count("serve/bucket_hit")
     out = bst.predict(X, predict_on_device=True)
-    assert np.array_equal(out, bst.predict(X, predict_on_device=False))
+    assert registry.count("serve/bucket_compile") \
+        + registry.count("serve/bucket_hit") > base
+    assert np.array_equal(out, host)
 
 
 def test_round_down_f32_is_largest_f32_below():
@@ -179,9 +195,10 @@ def test_booster_predict_fast_path_matches_host(shared):
         + registry.count("serve/bucket_hit") == dispatched
 
 
-def test_booster_predict_f64_rows_fall_back_to_host(shared):
-    """Rows that exceed f32 precision cannot quantize exactly — the
-    fast path must decline them, not approximate."""
+def test_booster_predict_f64_rows_take_device_dd_path(shared):
+    """Rows that exceed f32 precision used to decline to the host walk;
+    the double-double (hi + exact residual) encoding now serves them on
+    device BIT-identically to the host's f64 compares (ISSUE 11)."""
     X, bst, _ = shared
     X64 = X + np.random.RandomState(13).randn(*X.shape) * 1e-12
     X64[:, 4] = X[:, 4]  # keep categories integral
@@ -189,8 +206,12 @@ def test_booster_predict_f64_rows_fall_back_to_host(shared):
         + registry.count("serve/bucket_hit")
     out = bst.predict(X64, predict_on_device=True)
     assert registry.count("serve/bucket_compile") \
-        + registry.count("serve/bucket_hit") == base
+        + registry.count("serve/bucket_hit") > base, \
+        "f64 rows did not dispatch through the device dd path"
     assert np.array_equal(out, bst.predict(X64, predict_on_device=False))
+    # the dd program runs under its own bucket keys
+    predictor = bst._stacked_cache[1]
+    assert any(len(k) == 4 and k[3] == "dd" for k in predictor.entries)
 
 
 # ----------------------------------------------------------------------

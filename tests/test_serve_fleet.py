@@ -1,0 +1,537 @@
+"""Mesh-replicated serving fleet (ISSUE 11).
+
+Acceptance contract: multi-replica responses are BIT-identical to the
+single-replica device predict for every request shape
+(regression/binary/multiclass × EFB-bundled × oversized-split), the
+breaker/canary/drain semantics are unchanged at N replicas (canary
+pinned to replica 0), EFB-bundled / linear-leaf / f64 batches emit no
+``backend_fallback`` or host-walk ``perf_warning`` events (the device
+path serves them all), serving the same shape bucket on N replicas adds
+ZERO new jit traces beyond the single-replica count, and the per-replica
+serve series export as ``{replica="k"}``-labeled OpenMetrics families.
+
+Most tests replicate on ONE CPU device (replica workers wrap around the
+device list — the queue/canary/drain semantics are device-count
+independent); ``test_forced_host_device_count_multi_device`` runs the
+same parity + trace-budget contract on 4 REAL host devices in a
+subprocess (``--xla_force_host_platform_device_count`` must be set
+before jax initializes).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import compile as obs_compile
+from lightgbm_tpu.obs import events
+from lightgbm_tpu.obs import faults
+from lightgbm_tpu.obs.registry import registry
+from lightgbm_tpu.serve import (BreakerOpen, ModelRegistry, PredictServer,
+                                ReplicatedForest, StackedForest,
+                                compile_predict_with_plan)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    yield
+    faults.reset()
+    events.configure(None)
+    events.register_event_callback(None)
+    registry.disable()
+
+
+def _data(n=400, seed=0, n_feat=6, with_nan=True, with_cat=True):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, n_feat).astype(np.float32).astype(np.float64)
+    if with_nan:
+        X[rng.rand(n) < 0.15, 2] = np.nan
+    if with_cat:
+        X[:, 4] = rng.randint(0, 9, n)
+    y = (X[:, 0] + 0.5 * np.nan_to_num(X[:, 2])
+         + (X[:, 4] % 3 == 1) > 0.2).astype(float)
+    return X, y
+
+
+def _train(objective, X, y, rounds=6, **extra):
+    params = {"objective": objective, "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "max_bin": 63,
+              "categorical_feature": [4]}
+    params.update(extra)
+    return lgb.train(params, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds)
+
+
+@pytest.fixture(scope="module")
+def shared():
+    X, y = _data(n=640, seed=11)
+    bst = _train("binary", X, y, rounds=10)
+    return X, bst, bst.predict(X, predict_on_device=False)
+
+
+def _serve_all(srv, X, n_single=64, big=True):
+    """Submit a mix of single rows, blocks, and (optionally) an
+    oversized request; return the reassembled answers."""
+    futs = [srv.submit(X[i]) for i in range(n_single)]
+    blk = srv.submit(X[:48])
+    singles = np.array([f.result(timeout=120) for f in futs])
+    out = [singles, np.asarray(blk.result(timeout=120))]
+    if big:  # rows > max_batch: chunks dispatch on different replicas
+        out.append(np.asarray(srv.predict(X, timeout=120)))
+    return out
+
+
+# ----------------------------------------------------------------------
+# bit-parity: multi-replica == single-replica == host
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("objective,extra", [
+    ("binary", {}),
+    ("regression", {}),
+    ("multiclass", {"num_class": 3, "num_leaves": 7}),
+])
+def test_multi_replica_bit_parity(objective, extra):
+    X, y = _data()
+    label = (y if objective == "binary"
+             else X[:, 0] + np.nan_to_num(X[:, 2])
+             if objective == "regression"
+             else (X[:, 4] % 3).astype(float))
+    bst = _train(objective, X, label, **extra)
+    host = bst.predict(X, predict_on_device=False)
+    forest = StackedForest.from_gbdt(bst)
+
+    s1 = PredictServer(forest, max_batch=64, max_wait_ms=1)
+    ref = _serve_all(s1, X)
+    s1.stop()
+
+    def _disp_total():
+        return sum(registry.count(
+            "serve/dispatches/replica/%d/model/default" % k)
+            for k in range(4))
+
+    d0 = _disp_total()
+    s4 = PredictServer(forest, max_batch=64, max_wait_ms=1, replicas=4)
+    assert s4.replicas == 4 and len(s4.predictors) == 4
+    got = _serve_all(s4, X)
+    disp = s4.stats["dispatches"]
+    s4.stop()
+    for r, g in zip(ref, got):
+        assert np.array_equal(r, g), objective
+    assert np.array_equal(got[0], host[:64])
+    assert np.array_equal(got[2], host)
+    # every dispatch is attributed to exactly one replica
+    assert _disp_total() - d0 == disp
+
+
+def test_multi_replica_efb_wide_sparse_lut(tmp_path):
+    """EFB-style wide sparse one-hot model: the LUT-node encoding with
+    used-feature-compacted gathers serves it bit-identically, on every
+    replica, with no host-walk / fallback events."""
+    rng = np.random.RandomState(5)
+    n, groups, cards = 500, 8, 12
+    cats = rng.randint(0, cards, (n, groups))
+    X = np.zeros((n, groups * cards), dtype=np.float64)
+    for g in range(groups):
+        X[np.arange(n), g * cards + cats[:, g]] = 1.0
+    y = ((cats[:, 0] + cats[:, 1]) % 3 == 1).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "max_bin": 63, "enable_bundle": True},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    host = bst.predict(X, predict_on_device=False)
+    path = str(tmp_path / "efb_events.jsonl")
+    events.configure(path)
+    for lut in ("auto", True, False):
+        forest = StackedForest.from_gbdt(bst, lut=lut)
+        if lut is True:
+            assert forest.lut_nodes
+        srv = PredictServer(forest, max_batch=64, max_wait_ms=1,
+                            replicas=3)
+        got = srv.predict(X, timeout=120)
+        srv.stop()
+        assert np.array_equal(host, got), "lut=%s" % lut
+    events.configure(None)
+    bad = [r for r in events.read_jsonl(path)
+           if r["event"] in ("perf_warning", "backend_fallback")]
+    assert not bad, bad
+
+
+def test_multi_replica_linear_and_f64_no_host_walk(tmp_path):
+    """Linear-leaf models and f64 batches take the device fast path on
+    every replica — bit-identical answers, zero fallback events."""
+    path = str(tmp_path / "lin_events.jsonl")
+    X, y = _data(n=300, seed=9, with_nan=False, with_cat=False)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1, "min_data_in_leaf": 20,
+                     "max_bin": 63, "linear_tree": True},
+                    lgb.Dataset(X, label=X[:, 0]), num_boost_round=3)
+    X64 = X + np.random.RandomState(3).randn(*X.shape) * 1e-12
+    host = bst.predict(X, predict_on_device=False)
+    host64 = bst.predict(X64, predict_on_device=False)
+    events.configure(path)
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=64,
+                        max_wait_ms=1, replicas=3)
+    got = srv.predict(X, timeout=120)          # linear, f32-exact rows
+    got64 = srv.predict(X64, timeout=120)      # linear, true-f64 rows
+    single64 = srv.predict(X64[7], timeout=120)
+    srv.stop()
+    events.configure(None)
+    assert np.array_equal(host, got)
+    assert np.array_equal(host64, got64)
+    assert single64 == host64[7]
+    bad = [r for r in events.read_jsonl(path)
+           if r["event"] in ("perf_warning", "backend_fallback")]
+    assert not bad, bad
+
+
+def test_forced_host_walk_still_warns(shared, tmp_path):
+    """The remaining legitimate declines (pred_early_stop) under a
+    FORCED predict_on_device emit an assertable perf_warning — the
+    no-events assertions above are meaningful because a decline is
+    never silent."""
+    X, bst, host = shared
+    path = str(tmp_path / "walk_events.jsonl")
+    events.configure(path)
+    out = bst.predict(X, predict_on_device=True, pred_early_stop=True)
+    events.configure(None)
+    walked = [r for r in events.read_jsonl(path)
+              if r["event"] == "perf_warning"
+              and r.get("component") == "serve.host_walk"]
+    assert walked, "forced decline emitted no perf_warning"
+
+
+# ----------------------------------------------------------------------
+# compile-cache sharing: zero new traces beyond the single-replica count
+# ----------------------------------------------------------------------
+
+def test_zero_new_traces_across_replicas(shared):
+    X, bst, host = shared
+    forest = StackedForest.from_gbdt(bst)
+    s1 = PredictServer(forest, max_batch=64, max_wait_ms=1)
+    s1.predict(X[:64], timeout=120)     # warm the 64-bucket
+    s1.predict(X[:10], timeout=120)     # ... and the 16-bucket
+    s1.stop()
+    before = {k: v for k, v in obs_compile.trace_counts().items()
+              if k.startswith("serve.")}
+    cache0 = registry.count("serve/bucket_compile")
+    s4 = PredictServer(forest, max_batch=64, max_wait_ms=1, replicas=4)
+    s4.warm(X[:64])                     # dispatches on EVERY replica
+    for _ in range(3):
+        futs = [s4.submit(X[:64]) for _ in range(4)]
+        for f in futs:
+            assert np.array_equal(f.result(timeout=120), host[:64])
+    s4.predict(X[:10], timeout=120)
+    s4.stop()
+    after = {k: v for k, v in obs_compile.trace_counts().items()
+             if k.startswith("serve.")}
+    assert before == after, (
+        "N replicas must not add jit traces beyond the single-replica "
+        "count: %s -> %s" % (before, after))
+    # the shared bucket policy: 4 replicas × 2 shape buckets create
+    # exactly 2 policy entries (one per bucket), the same as a
+    # single-replica server — NOT 2 per replica
+    assert registry.count("serve/bucket_compile") - cache0 == 2
+    assert len(s4.predictors[0].entries) == 2
+    assert s4.predictors[0].entries is s4.predictors[3].entries
+
+
+# ----------------------------------------------------------------------
+# breaker / canary / drain semantics at N replicas
+# ----------------------------------------------------------------------
+
+def test_canary_pinned_to_replica_zero(shared, tmp_path):
+    """A canary window at N replicas: only replica 0 routes canary
+    batches (the others keep serving stable), a poisoned canary rolls
+    back exactly as at 1 replica, and a clean window promotes.
+
+    The poison is a NON-FINITE canary model (rather than an injected
+    nth:1 dispatch fault, which at N replicas can land on a stable
+    replica's dispatch first): the canary screen's output check fires
+    only where the canary routes — replica 0 — so the rollback is
+    deterministic whatever order the workers pop batches in."""
+    path = str(tmp_path / "canary_events.jsonl")
+    events.configure(path)
+    X, bst, host = shared
+    reg = ModelRegistry()
+    v1 = reg.load("m", booster=bst, num_iteration=4)
+    rb0 = registry.count("serve/rollbacks")
+    srv = PredictServer(reg, name="m", max_batch=64, max_wait_ms=1,
+                        replicas=3)
+    ref_v1 = srv.predict(X[:32], timeout=120)
+    # --- poisoned canary -> rollback, callers keep being served ------
+    poisoned = lgb.Booster(model_str=bst.model_to_string())
+    for t in poisoned.inner.models:
+        t.leaf_value[:t.num_leaves] = np.nan  # NaN survives the
+        #              objective transform; +inf would sigmoid to 1.0
+    reg.publish("m", StackedForest.from_gbdt(poisoned),
+                canary_batches=2)
+    # replica 0 is the only canary router and takes ~1/N of the
+    # batches: drive until it screens the non-finite output (bounded —
+    # the window length is measured in replica-0 dispatches)
+    outs = []
+    for _ in range(80):
+        outs.append(srv.predict(X[:32], timeout=120))
+        if registry.count("serve/rollbacks") - rb0:
+            break
+    assert registry.count("serve/rollbacks") - rb0 == 1
+    assert reg.get("m")[0] == v1
+    for o in outs:  # every answer bit-identical to the v1 model
+        assert np.array_equal(o, ref_v1)
+    # --- clean window -> promote; all replicas pick the new version up
+    v3 = reg.load("m", booster=bst, canary_batches=2)
+    for _ in range(80):
+        srv.predict(X[:32], timeout=120)
+        if reg.get("m")[0] == v3:
+            break
+    assert reg.get("m")[0] == v3
+    full = srv.predict(X[:32], timeout=120)
+    srv.stop()
+    events.configure(None)
+    assert np.array_equal(full, host[:32])
+    evs = events.read_jsonl(path)
+    assert [e["event"] for e in evs if e["event"] == "model_rollback"]
+    promoted = [e for e in evs if e["event"] == "model_swap"
+                and e.get("canary")]
+    assert promoted and promoted[0]["version"] == v3
+
+
+def test_breaker_and_drain_at_n_replicas(shared):
+    """The ONE breaker covers the whole fleet (global overload
+    semantics), and a drain strands no Future with N workers."""
+    import concurrent.futures as cf
+    X, bst, _ = shared
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=16,
+                        max_wait_ms=1, replicas=4, breaker_threshold=3,
+                        breaker_cooldown_ms=60_000)
+    faults.configure("serve_dispatch:always")
+    try:
+        failures = []
+        for i in range(12):
+            try:
+                srv.predict(X[i], timeout=120)
+            except Exception as e:  # noqa: BLE001
+                failures.append(e)
+        assert len(failures) == 12
+        assert any(isinstance(e, BreakerOpen) for e in failures), \
+            "breaker never opened across the fleet"
+    finally:
+        faults.reset()
+    srv.stop()
+    # a fresh fleet drains cleanly: queue a burst, stop immediately,
+    # every Future resolves (result or typed error), none hang
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=16,
+                        max_wait_ms=50, replicas=4, autostart=False)
+    futs = [srv.submit(X[i]) for i in range(40)]
+    srv.start()
+    srv.stop(drain_timeout_s=30)
+    unresolved = 0
+    for f in futs:
+        try:
+            f.result(timeout=0)
+        except cf.TimeoutError:
+            unresolved += 1
+        except Exception:
+            pass
+    assert unresolved == 0, "%d futures stranded by drain" % unresolved
+
+
+# ----------------------------------------------------------------------
+# per-replica telemetry + export
+# ----------------------------------------------------------------------
+
+def test_replica_labeled_metrics_export(shared):
+    from lightgbm_tpu.obs.export import (metric_value, parse_openmetrics,
+                                         render_openmetrics)
+    X, bst, _ = shared
+    srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=32,
+                        max_wait_ms=1, replicas=2)
+    srv.warm(X[:32])
+    srv.predict(X[:32], timeout=120)
+    stats = srv.replica_stats()
+    srv.stop()
+    assert set(stats) == {0, 1}
+    assert sum(s["dispatches"] for s in stats.values()) > 0
+    text = render_openmetrics(registry)
+    parsed = parse_openmetrics(text)
+    assert metric_value(parsed, "lightgbm_tpu_serve_replicas") == 2
+    for k, s in stats.items():
+        if not s["dispatches"]:
+            continue
+        # the series carry BOTH labels: two servers in one process must
+        # not clobber each other's per-replica numbers
+        assert metric_value(parsed, "lightgbm_tpu_serve_dispatches_total",
+                            replica=str(k),
+                            model="default") == s["dispatches"]
+        assert metric_value(parsed, "lightgbm_tpu_serve_latency_ms",
+                            replica=str(k), model="default",
+                            quantile="0.99") is not None
+    # one # TYPE header per family even with mixed labeled/unlabeled
+    lat_types = [ln for ln in text.splitlines()
+                 if ln == "# TYPE lightgbm_tpu_serve_latency_ms summary"]
+    assert len(lat_types) == 1
+
+
+# ----------------------------------------------------------------------
+# one-program row-sharded dispatch (compile_step_with_plan pattern)
+# ----------------------------------------------------------------------
+
+def test_sharded_program_bit_parity(shared):
+    X, bst, _ = shared
+    forest = StackedForest.from_gbdt(bst)
+    rf = ReplicatedForest(forest)
+    single = np.asarray(forest.predict_raw_device(X[:100]))
+    sharded = rf.predict_raw_sharded(X[:100])
+    assert np.array_equal(single, sharded)
+    # pjit route demands BOTH shardings (the compile_step_with_plan
+    # contract); 1-device meshes take the plain jit route
+    with pytest.raises(ValueError, match="BOTH"):
+        compile_predict_with_plan(lambda x: x, rf.mesh, in_shardings=1)
+
+
+def test_sharded_bucket_divides_any_mesh():
+    """The padded row bucket must divide evenly on NON-power-of-two
+    meshes too (a bare power of two never divides a 3- or 6-device
+    mesh and shard_map would reject the dispatch)."""
+    from lightgbm_tpu.serve.replicate import sharded_bucket
+    for n in (1, 5, 16, 100, 1000):
+        for d in (1, 2, 3, 4, 5, 6, 7, 8):
+            b = sharded_bucket(n, d)
+            assert b % d == 0 and b >= max(n, 16), (n, d, b)
+
+
+def test_dd_linear_nan_fallback_on_device_path():
+    """The dd throughput path must apply the linear-leaf NaN fallback:
+    the encoder keeps NaN visible in the hi word (the quantizer
+    substitutes the (0,0) pair itself), so a NaN in a fitted leaf
+    feature falls back to the constant leaf value exactly like the f32
+    device path and the host walk."""
+    rng = np.random.RandomState(2)
+    X = rng.randn(400, 5)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1, "min_data_in_leaf": 20,
+                     "max_bin": 63, "linear_tree": True},
+                    lgb.Dataset(X, label=X[:, 0] * 2 + X[:, 1]),
+                    num_boost_round=3)
+    forest = StackedForest.from_gbdt(bst)
+    assert forest.has_linear
+    # NaN rows stay f32-exact; other rows are perturbed off the f32
+    # grid, forcing the whole batch onto the dd program — the NaN rows
+    # must then match the f32 program's values BIT-for-bit
+    Xf = X.astype(np.float32).astype(np.float64)
+    X64 = Xf + rng.randn(*X.shape) * 1e-12
+    nan_rows = np.arange(0, 400, 7)
+    X64[nan_rows] = Xf[nan_rows]
+    X64[nan_rows, 1] = np.nan
+    dev_dd = np.asarray(forest.predict_raw_device(X64))[:, 0]
+    Xf_nan = Xf.copy()
+    Xf_nan[nan_rows, 1] = np.nan
+    dev_f32 = np.asarray(forest.predict_raw_device(
+        Xf_nan.astype(np.float32)))[:, 0]
+    assert np.array_equal(dev_dd[nan_rows], dev_f32[nan_rows])
+    # the bit-exact host-contract path agrees with the host walk too
+    assert np.array_equal(bst.predict(X64, predict_on_device=False),
+                          forest.predict(X64))
+
+
+# ----------------------------------------------------------------------
+# real multi-device: forced host device count (subprocess)
+# ----------------------------------------------------------------------
+
+_FLEET_CHILD = r"""
+import numpy as np, jax
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import compile as obs_compile
+from lightgbm_tpu.serve import PredictServer, ReplicatedForest, StackedForest
+assert len(jax.devices()) == 4, jax.devices()
+rng = np.random.RandomState(0)
+X = rng.randn(400, 6).astype(np.float32).astype(np.float64)
+X[rng.rand(400) < 0.2, 2] = np.nan
+y = (X[:, 0] > 0).astype(float)
+bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                 "verbosity": -1, "min_data_in_leaf": 5, "max_bin": 63},
+                lgb.Dataset(X, label=y), num_boost_round=6)
+host = bst.predict(X, predict_on_device=False)
+forest = StackedForest.from_gbdt(bst)
+s1 = PredictServer(forest, max_batch=64, max_wait_ms=1)
+assert np.array_equal(s1.predict(X[:64], timeout=240), host[:64])
+s1.predict(X[:20], timeout=240)   # coalesced batches land on any pow2
+s1.predict(X[:10], timeout=240)   # bucket <= 64: warm them all
+s1.stop()
+t0 = {k: v for k, v in obs_compile.trace_counts().items()
+      if k.startswith("serve.")}
+s4 = PredictServer(forest, max_batch=64, max_wait_ms=1, replicas="auto")
+assert s4.replicas == 4
+assert {d.id for d in s4._devices} == {0, 1, 2, 3}
+s4.warm(X[:64])
+futs = [s4.submit(X[i]) for i in range(160)]
+got = np.array([f.result(timeout=240) for f in futs])
+big = s4.predict(X, timeout=240)           # oversized: splits across devices
+s4.predict(X[:10], timeout=240)
+s4.stop()
+assert np.array_equal(got, host[:160])
+assert np.array_equal(big, host)
+t1 = {k: v for k, v in obs_compile.trace_counts().items()
+      if k.startswith("serve.")}
+assert t0 == t1, ("replicas added traces", t0, t1)
+rf = ReplicatedForest(forest)
+assert rf.num_replicas == 4
+one = np.asarray(forest.place(jax.devices()[0]).predict_raw_device(
+    X[:128].astype(np.float32)))
+assert np.array_equal(one, rf.predict_raw_sharded(X[:128].astype(np.float32)))
+print("FLEET_MULTI_DEVICE_OK")
+"""
+
+
+def test_forced_host_device_count_multi_device():
+    """The real thing: 4 forced host devices, parity + trace budget +
+    oversized splits + the one-program shard_map dispatch."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=4"
+                        ).strip()
+    env.pop("LIGHTGBM_TPU_EVENT_LOG", None)
+    out = subprocess.run([sys.executable, "-c", _FLEET_CHILD],
+                         capture_output=True, text=True, timeout=600,
+                         env=env, cwd=os.path.dirname(
+                             os.path.dirname(os.path.abspath(__file__))))
+    assert "FLEET_MULTI_DEVICE_OK" in out.stdout, (
+        out.stdout[-2000:], out.stderr[-4000:])
+
+
+# ----------------------------------------------------------------------
+# the double-double encoding: exactness where f32 cannot reach
+# ----------------------------------------------------------------------
+
+def test_dd_exact_on_f32_colliding_thresholds():
+    """Two f64 thresholds that round down to the SAME f32 — the pair
+    (round-down f32, exact residual rank) still distinguishes them, so
+    f64 decisions match the host walk bit-for-bit."""
+    from lightgbm_tpu.io.binning import MissingType
+    from lightgbm_tpu.models.tree import Tree
+    t1 = 1.0 + 2 ** -41
+    t2 = 1.0 + 2 ** -40
+    assert np.float32(t1) == np.float32(t2)
+
+    def mk(thresh):
+        t = Tree(2)
+        t.split(leaf=0, feature=0, feature_inner=0, threshold_bin=0,
+                threshold_real=thresh, left_value=-1.0, right_value=1.0,
+                left_count=5, right_count=5, left_weight=1.0,
+                right_weight=1.0, gain=1.0,
+                missing_type=MissingType.NONE, default_left=False)
+        return t
+
+    trees = [mk(t1), mk(t2)]
+    forest = StackedForest(trees, num_tree_per_iteration=1,
+                           num_features=1)
+    vals = np.array([1.0, t1, (t1 + t2) / 2, t2, t2 + 2 ** -52,
+                     1.0 + 2 ** -30, 0.5, 2.0], dtype=np.float64)
+    X = vals.reshape(-1, 1)
+    host = sum(t.predict(X) for t in trees)
+    assert np.array_equal(host, forest.predict_raw(X))
+    leaves = forest.leaves(X)
+    for i, t in enumerate(trees):
+        assert np.array_equal(t.predict_leaf_index(X), leaves[:, i])

@@ -199,11 +199,11 @@ def test_worker_survives_failure_outside_the_predict_call(shared,
     orig_route = srv.registry.route
     boom = [True]
 
-    def route_once(name):
+    def route_once(name, **kwargs):
         if boom[0]:
             boom[0] = False
             raise MemoryError("routing blew up")
-        return orig_route(name)
+        return orig_route(name, **kwargs)
 
     monkeypatch.setattr(srv.registry, "route", route_once)
     doomed = srv.submit(X[0])
