@@ -414,11 +414,18 @@ def run_grow_bench() -> dict:
     - ``grow_stagings_per_tree_kbatch`` / ``_stepped`` and
       ``grow_staging_cut_kbatch``: out-of-core shard stagings per tree
       with K-splits-per-sweep frontier batching vs one-split-per-sweep
-      (the ≥4x acceptance metric at num_leaves=63).
+      (the ≥4x acceptance metric at num_leaves=63);
+    - ``grow_dispatches_per_iteration``: PIPELINED boosting — training
+      stage-scope calls per ITERATION with the batched quantized scan
+      (gradients + bagging draw + gh staging + whole-tree growth +
+      score update all inside one ``train_many`` dispatch per batch;
+      acceptance ≤ 4 vs ~6+ looped), plus
+      ``pipeline_speedup_batched_vs_looped`` (warmed wall-time ratio of
+      the per-iteration loop over the batched scan, same config).
 
     Env knobs: BENCH_GROW_ROWS (200k), BENCH_GROW_ITERS (3),
     BENCH_GROW_LEAVES (63), BENCH_GROW_K (16), BENCH_GROW_OOC_ROWS
-    (120k)."""
+    (120k), BENCH_GROW_BATCH (8)."""
     import shutil
     import tempfile
 
@@ -524,6 +531,64 @@ def run_grow_bench() -> dict:
            grow_stagings_per_tree_stepped=st_1,
            grow_staging_cut_kbatch=round(cut, 2))
 
+    # --- pipelined boosting: dispatches per ITERATION, batched vs
+    # looped (quantized + bagging — the full on-device iteration) ------
+    batch_n = int(os.environ.get("BENCH_GROW_BATCH", 8))
+    pipe_iters = 2 * batch_n
+    # every training stage scope that wraps device dispatch work in the
+    # boosting loop; the batched path folds all of them into ONE
+    # tree::train_batch_dispatch per batch_n iterations
+    PIPE_SCOPES = GROW_SCOPES + (
+        "gbdt::gradients", "gbdt::bagging", "gbdt::score_update",
+        "gbdt::eval_metrics", "tree::train_batch_dispatch")
+
+    def measure_pipeline(batched: bool):
+        params = dict(base, tree_learner="data", mesh_shape="data=1",
+                      use_quantized_grad=True,
+                      bagging_fraction=0.8, bagging_freq=1,
+                      tpu_batch_iterations=(batch_n if batched else 0),
+                      num_iterations=pipe_iters + 1)
+        cfg = Config.from_params(params)
+        ds = BinnedDataset.from_matrix(X, cfg, label=y)
+        booster = create_boosting(cfg, ds)
+        booster.train_one_iter()            # iter 0 + warm compiles
+        if batched:
+            assert booster.can_train_batched(), \
+                "quantized+bagging must be batch-eligible"
+            booster.train_batch(batch_n)    # warm the scan compile
+        else:
+            booster.train_one_iter()
+        jax.block_until_ready(booster.train_score)
+        obs_registry.reset()
+        obs_registry.enable()
+        t0 = time.time()
+        if batched:
+            for _ in range(pipe_iters // batch_n):
+                booster.train_batch(batch_n)
+        else:
+            for _ in range(pipe_iters):
+                booster.train_one_iter()
+        jax.block_until_ready(booster.train_score)
+        secs = time.time() - t0
+        phases = obs_registry.phases()
+        calls = sum(phases.get(s, {}).get("calls", 0)
+                    for s in PIPE_SCOPES)
+        obs_registry.disable()
+        return secs, calls / max(pipe_iters, 1)
+
+    t_batched, disp_iter = measure_pipeline(True)
+    t_looped, disp_iter_looped = measure_pipeline(False)
+    pipe_speedup = t_looped / max(t_batched, 1e-9)
+    _stage("grow_pipeline", rows=rows, batch=batch_n,
+           t_batched=round(t_batched, 2), t_looped=round(t_looped, 2),
+           grow_dispatches_per_iteration=round(disp_iter, 3),
+           grow_dispatches_per_iteration_looped=round(
+               disp_iter_looped, 3),
+           pipeline_speedup_batched_vs_looped=round(pipe_speedup, 3))
+    if disp_iter > 4.0:
+        print("Warning: grow_dispatches_per_iteration %.2f exceeds the "
+              "pipelined-boosting acceptance bound of 4" % disp_iter)
+
     return {
         "metric": "grow_speedup_fused_vs_stepped",
         "value": round(speedup, 3),
@@ -531,9 +596,12 @@ def run_grow_bench() -> dict:
                 "stepped host loop on %s (%.0fk rows x %df, %d leaves, "
                 "%d iters; %.0f grow dispatches/tree fused vs %.0f "
                 "stepped; out-of-core K=%d cuts shard stagings "
-                "%.1f->%.1f per tree = %.2fx)"
+                "%.1f->%.1f per tree = %.2fx; pipelined boosting: "
+                "%.2f dispatches/iteration batched-quantized vs %.1f "
+                "looped, %.2fx wall)"
                 % (platform, rows / 1e3, n_feat, leaves, iters,
-                   disp_fused, disp_stepped, kfront, st_1, st_k, cut),
+                   disp_fused, disp_stepped, kfront, st_1, st_k, cut,
+                   disp_iter, disp_iter_looped, pipe_speedup),
         "backend": platform,
         "grow_dispatches_per_tree": disp_fused,
         "grow_dispatches_per_tree_stepped": disp_stepped,
@@ -542,6 +610,10 @@ def run_grow_bench() -> dict:
         "grow_stagings_per_tree_kbatch": st_k,
         "grow_stagings_per_tree_stepped": st_1,
         "grow_staging_cut_kbatch": round(cut, 2),
+        "grow_dispatches_per_iteration": round(disp_iter, 3),
+        "grow_dispatches_per_iteration_looped": round(disp_iter_looped,
+                                                      3),
+        "pipeline_speedup_batched_vs_looped": round(pipe_speedup, 3),
     }
 
 

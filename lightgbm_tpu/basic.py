@@ -293,7 +293,10 @@ class Booster:
             from .ops_refresh import refresh_learner_params
             refresh_learner_params(inner.learner, cfg)
         if getattr(inner, "sample_strategy", None) is not None:
-            inner.sample_strategy.config = cfg
+            # strategies cache config-derived draw state (fractions,
+            # freq, GOSS warm-up); refresh re-derives it so scheduled
+            # bagging params keep their pre-refactor live semantics
+            inner.sample_strategy.refresh_config(cfg)
         return self
 
     @property
@@ -323,6 +326,7 @@ class Booster:
         # one eval pass = one gbdt::eval_metrics scope + one `eval`
         # event, via the shared instrumentation point in boosting/gbdt.py
         from .boosting.gbdt import run_instrumented_eval
+        self.inner._flush_valid_pending()  # eval-hoisting deferrals
         return run_instrumented_eval(
             self.inner.iter,
             lambda: self._eval_inner(valid_idx, name, feval))

@@ -68,6 +68,22 @@ _apply_leaf_delta = obs_compile.instrument_jit(
 _add_score_col = obs_compile.instrument_jit(
     "gbdt.score_add_col",
     lambda score, delta, k: score.at[:, k].add(delta))
+_set_score_col = obs_compile.instrument_jit(
+    "gbdt.score_set_col",
+    lambda score, col, k: score.at[:, k].set(col))
+
+
+def eval_hoist_due(count: int, last_count: int, eval_k: int,
+                   final: bool) -> bool:
+    """THE eval-hoisting grid predicate (``tpu_eval_iterations=k``),
+    shared by the engine's batched + per-iteration loops and the GBDT
+    CLI loop so the contract cannot drift between them: evaluation is
+    due when the iteration count crossed a multiple of k since the
+    last eval (an ABSOLUTE grid — a checkpoint-resumed run evaluates
+    at the same iterations as an uninterrupted one), always at the
+    final/stopping point, and always with hoisting off (k <= 1)."""
+    return (eval_k <= 1 or final
+            or (count // eval_k) > (last_count // eval_k))
 
 
 def run_instrumented_eval(iter_idx: int, compute):
@@ -181,6 +197,11 @@ class GBDT:
         self.shrinkage_rate = float(config.learning_rate)
         self.average_output = False
         self.loaded_parameter = ""
+        # valid-set tree replays deferred by the batched driver until an
+        # evaluation actually needs the scores (eval hoisting): (tree,
+        # class_id) pairs flushed — in append order, so the f32 add
+        # sequence is unchanged — by _flush_valid_pending
+        self._valid_pending: List[Tuple[Tree, int]] = []
 
         if config.objective in ("multiclass", "multiclassova"):
             self.num_class = int(config.num_class)
@@ -289,6 +310,9 @@ class GBDT:
             log.fatal("sharded datasets cannot be validation sets; "
                       "bin the validation rows in-memory (they are "
                       "scored per tree, not histogrammed)")
+        # deferred replays target the PRE-registration valid sets; the
+        # new set replays the full model list below
+        self._flush_valid_pending()
         metrics = []
         for name in resolve_metric_names(self.config, self.config.objective):
             m = create_metric(name, self.config)
@@ -478,18 +502,21 @@ class GBDT:
 
     def can_train_batched(self) -> bool:
         """True when T iterations can run without host participation:
-        single-model objective, no row sampling (bagging/GOSS draw host
-        RNG per iteration), no leaf-output renewal or linear refits
-        (host-side percentiles / least squares per tree), and a learner
-        whose scan needs no per-tree host state."""
-        from .sample_strategy import SampleStrategy
+        single-model objective with deterministic gradients, no
+        leaf-output renewal or linear refits (host-side percentiles /
+        least squares per tree), a sample strategy whose draw keys on
+        the iteration index (bagging/GOSS fold_in — see
+        sample_strategy.py; custom strategies without ``apply_traced``
+        decline), and a learner whose scan needs no per-tree host
+        state."""
         return (self._supports_batched
                 and self.objective is not None
                 and not self.objective.is_renew_tree_output
                 and not getattr(self.objective,
                                 "has_stochastic_gradients", False)
                 and not self.config.linear_tree
-                and type(self.sample_strategy) is SampleStrategy
+                and getattr(self.sample_strategy, "supports_device_draw",
+                            lambda: False)()
                 and len(self.models) >= 1  # iter 0 seeds boost_from_avg
                 and all(self.class_need_train)
                 and getattr(self.learner, "supports_train_many",
@@ -502,6 +529,7 @@ class GBDT:
         can_train_batched()."""
         from ..treelearner.serial import (apply_split_record,
                                           record_is_valid)
+        from .sample_strategy import SampleStrategy
         t_batch0 = time.perf_counter()
         learner = self.learner
         K = self.num_tree_per_iteration
@@ -509,16 +537,23 @@ class GBDT:
         if K == 1:
             seeds = [(learner._extra_seed + 7919 * (base + 1 + t))
                      & 0x7FFFFFFF for t in range(n_iters)]
-            score0 = self.train_score[:, 0]
+            score0 = _take_col(self.train_score, dev_i32(0))
         else:
             seeds = [[(learner._extra_seed
                        + 7919 * (base + 1 + t * K + k)) & 0x7FFFFFFF
                       for k in range(K)] for t in range(n_iters)]
             score0 = self.train_score
+        # the scanned iteration numbers drive the sample strategy's
+        # on-device fold_in draws — the exact indices the looped path's
+        # per-iteration ``bagging(self.iter, ...)`` calls would consume
+        iters = np.arange(self.iter, self.iter + n_iters, dtype=np.int32)
+        sample = (None
+                  if type(self.sample_strategy) is SampleStrategy
+                  else self.sample_strategy)
         with obs.scope("tree::train_batch_dispatch"):
             score_t, recs = learner.train_many(
-                self.objective.get_gradients, score0, seeds,
-                self.shrinkage_rate)
+                self.objective.get_gradients, sample, score0, seeds,
+                iters, self.shrinkage_rate)
             # jaxlint: disable=JLT001 -- the batch's single deliberate
             # sync: n_iters trees' split records read back in one hop
             recs_h = jax.device_get(recs)
@@ -560,9 +595,13 @@ class GBDT:
             with obs.scope("tree::apply_records"):
                 for k, tree in enumerate(iter_trees):
                     self.models.append(tree)
-                    if tree.num_leaves > 1:
-                        for vd in self.valid_data:
-                            vd.add_tree(tree, k, self._bin_meta)
+                    if tree.num_leaves > 1 and self.valid_data:
+                        # valid-set replay DEFERRED to the next eval
+                        # (eval hoisting): the per-tree device traversal
+                        # leaves the iteration loop; flush order ==
+                        # append order, so the f32 add sequence — and
+                        # the eval results — are unchanged
+                        self._valid_pending.append((tree, k))
             self.iter += 1
             applied += 1
             # wall time amortized over the batch: the dispatch is one
@@ -581,10 +620,23 @@ class GBDT:
         # every step after it, which sees the same score and grows the
         # same stump) contributed zero output on device
         if K == 1:
-            self.train_score = self.train_score.at[:, 0].set(score_t)
+            self.train_score = _set_score_col(self.train_score, score_t,
+                                              dev_i32(0))
         else:
             self.train_score = score_t
         return stopped
+
+    def _flush_valid_pending(self) -> None:
+        """Replay valid-set tree outputs the batched driver deferred
+        (train_batch appends; every reader of valid scores — eval,
+        rollback, a late add_valid_data — flushes first)."""
+        if not self._valid_pending:
+            return
+        pending, self._valid_pending = self._valid_pending, []
+        with obs.scope("tree::apply_records"):
+            for tree, k in pending:
+                for vd in self.valid_data:
+                    vd.add_tree(tree, k, self._bin_meta)
 
     # ------------------------------------------------------------------
     def _initial_score(self) -> np.ndarray:
@@ -677,6 +729,7 @@ class GBDT:
         """reference: GBDT::RollbackOneIter (gbdt.cpp:438)."""
         if self.iter <= 0:
             return
+        self._flush_valid_pending()
         K = self.num_tree_per_iteration
         for k in range(K):
             tree = self.models[-K + k]
@@ -724,6 +777,7 @@ class GBDT:
     def eval_metrics(self) -> List[Tuple[str, str, float, bool]]:
         """Evaluate all metrics; returns (dataset_name, metric_name,
         value, is_bigger_better) tuples."""
+        self._flush_valid_pending()
         return run_instrumented_eval(self.iter, self._eval_metrics_inner)
 
     def _eval_metrics_inner(self) -> List[Tuple[str, str, float, bool]]:
@@ -813,6 +867,13 @@ class GBDT:
         begin_iter = self.iter
         end_iter = int(self.config.num_iterations)
         es_round = self.config.early_stopping_round
+        # eval hoisting (tpu_eval_iterations=k): evaluation — and the
+        # early-stopping check it feeds — runs on the absolute every-k
+        # iteration grid (plus the final iteration), so a resumed run
+        # evaluates at the same iterations as an uninterrupted one;
+        # the patience window still counts in iterations
+        eval_k = max(int(getattr(self.config, "tpu_eval_iterations", 1)),
+                     1)
         for it in range(begin_iter, end_iter):
             for cb in cbs_before:
                 cb(CallbackEnv(model=self, params={}, iteration=it,
@@ -821,11 +882,17 @@ class GBDT:
                                evaluation_result_list=None))
             finished = self.train_one_iter()
             eval_list = None
+            eval_due = True
             if not finished:
+                eval_due = eval_hoist_due(self.iter, self.iter - 1,
+                                          eval_k,
+                                          self.iter >= end_iter)
                 need_output = (self.config.metric_freq > 0
-                               and self.iter % self.config.metric_freq == 0)
-                need_eval = (need_output or cbs_after
-                             or (es_round > 0 and self.valid_data))
+                               and self.iter % self.config.metric_freq == 0
+                               and eval_due)
+                need_eval = eval_due and (
+                    need_output or cbs_after
+                    or (es_round > 0 and self.valid_data))
                 if need_eval:
                     eval_list = self.eval_metrics()
                 if need_output:
@@ -833,6 +900,7 @@ class GBDT:
                         log.info("Iteration:%d, %s %s : %g"
                                  % (self.iter, ds, name, v))
                 if es_round > 0 and self.valid_data \
+                        and eval_list is not None \
                         and self._check_early_stopping(eval_list):
                     # drop the over-trained models
                     K = self.num_tree_per_iteration
@@ -841,7 +909,11 @@ class GBDT:
                     self.iter = self.best_iteration
                     finished = True
             try:
-                for cb in cbs_after:
+                # after-callbacks fire only at eval points (same
+                # contract as the engine loops): feeding early_stopping
+                # an empty evaluation list on a skipped iteration would
+                # abort its _init
+                for cb in (cbs_after if eval_due else []):
                     cb(CallbackEnv(model=self, params={}, iteration=it,
                                    begin_iteration=begin_iter,
                                    end_iteration=end_iter,
@@ -863,6 +935,11 @@ class GBDT:
                 break
         if checkpoint_dir:
             self.save_checkpoint(checkpoint_dir)
+        # the sharded learner's cross-iteration sweep stash pins one
+        # staged shard buffer; no further tree will consume it now
+        rel = getattr(self.learner, "release_prefetch", None)
+        if rel is not None:
+            rel()
 
     # ------------------------------------------------------------------
     # Prediction over raw feature matrices (host)

@@ -355,6 +355,15 @@ class Config:
     # per-iteration host work (boosting/gbdt.py train_batch); amortizes
     # remote-chip dispatch latency. 0/1 = per-iteration training.
     tpu_batch_iterations: int = 0
+    # eval hoisting (pipelined boosting): run metric evaluation — and
+    # the after-iteration callbacks it feeds, incl. the early-stopping
+    # check — only when the iteration count crosses a multiple of k
+    # (absolute grid, resume-invariant), plus always at the final /
+    # stopping iteration. The early-stopping patience window still
+    # counts in iterations; k only coarsens where the check can fire.
+    # 0/1 = evaluate every iteration (every batch boundary when
+    # tpu_batch_iterations is on).
+    tpu_eval_iterations: int = 0
     # fused whole-tree growth (treelearner/serial.py): histogram →
     # split scan → partition for the entire tree runs as ONE jitted
     # while_loop dispatch with a device-resident frontier, reading back

@@ -109,6 +109,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
                      and it % checkpoint_freq == 0):
             booster.inner.save_checkpoint(checkpoint_dir)
 
+    def _finish_training() -> None:
+        """Terminal bookkeeping shared by every return path: the final
+        forced checkpoint, plus dropping the sharded learner's
+        cross-iteration sweep stash (it pins one staged shard buffer
+        that no further tree will consume)."""
+        _maybe_checkpoint(force=True)
+        rel = getattr(getattr(booster.inner, "learner", None),
+                      "release_prefetch", None)
+        if rel is not None:
+            rel()
+
     valid_sets = valid_sets or []
     valid_names = valid_names or []
     for i, vs in enumerate(valid_sets):
@@ -170,6 +181,22 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # BATCH boundaries — early stopping still measures its patience in
     # iterations (env.iteration advances by N), just checked N at a
     # time. Custom objectives are excluded by can_train_batched.
+    #
+    # tpu_eval_iterations=k hoists evaluation further: eval + the
+    # after-iteration callbacks run only when the iteration count
+    # crosses a multiple of k (absolute grid, so a checkpoint-resumed
+    # run evaluates at the same iterations as an uninterrupted one),
+    # plus always at the final/stopping iteration. The early-stopping
+    # callback still measures its patience window in iterations — k
+    # only coarsens WHERE the check can fire (docs/PERFORMANCE.md
+    # "Pipelined boosting" has the tolerance contract).
+    eval_k = max(int(cfg.tpu_eval_iterations), 1)
+    from .boosting.gbdt import eval_hoist_due
+    if eval_k > 1 and (callbacks or valid_sets):
+        log.info("tpu_eval_iterations=%d: evaluation/callbacks run "
+                 "when the iteration count crosses a multiple of %d"
+                 % (eval_k, eval_k))
+
     batch_n = int(cfg.tpu_batch_iterations)
     if batch_n > 1 and fobj is None:
         if callbacks or valid_sets:
@@ -177,6 +204,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                      "run every %d iterations (batch boundaries)"
                      % (batch_n, batch_n))
         i = resume_iter
+        last_eval = resume_iter
         degraded = False
         ran_batched = False
         rechecked = False
@@ -214,31 +242,40 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     log.warning(
                         "tpu_batch_iterations=%d ignored: the "
                         "configuration needs per-iteration host work "
-                        "(sampling/monotone/CEGB/linear/renewal, a "
+                        "(per-node masks / feature_fraction / monotone "
+                        "/ CEGB / linear / leaf-output renewal, a "
                         "stochastic-gradient objective, DART/RF "
                         "boosting, or a multi-process learner)"
                         % batch_n)
                     degraded = True
+            eval_due = eval_hoist_due(
+                i, last_eval, eval_k,
+                finished or degraded or i >= num_boost_round)
             evaluation_result_list = []
-            if valid_sets or eval_train_requested:
-                if eval_train_requested:
+            if eval_due:
+                last_eval = i
+                if valid_sets or eval_train_requested:
+                    if eval_train_requested:
+                        evaluation_result_list.extend(
+                            booster.eval_train(feval))
                     evaluation_result_list.extend(
-                        booster.eval_train(feval))
-                evaluation_result_list.extend(booster.eval_valid(feval))
-            try:
-                for cb in callbacks_after:
-                    cb(callback_mod.CallbackEnv(
-                        model=booster, params=params, iteration=i - 1,
-                        begin_iteration=0,
-                        end_iteration=num_boost_round,
-                        evaluation_result_list=evaluation_result_list))
-            except callback_mod.EarlyStopException as e:
-                booster.best_iteration = e.best_iteration + 1
-                for item in (e.best_score or []):
-                    booster.best_score.setdefault(
-                        item[0], {})[item[1]] = item[2]
-                _maybe_checkpoint(force=True)
-                return booster
+                        booster.eval_valid(feval))
+                try:
+                    for cb in callbacks_after:
+                        cb(callback_mod.CallbackEnv(
+                            model=booster, params=params,
+                            iteration=i - 1,
+                            begin_iteration=0,
+                            end_iteration=num_boost_round,
+                            evaluation_result_list=(
+                                evaluation_result_list)))
+                except callback_mod.EarlyStopException as e:
+                    booster.best_iteration = e.best_iteration + 1
+                    for item in (e.best_score or []):
+                        booster.best_score.setdefault(
+                            item[0], {})[item[1]] = item[2]
+                    _finish_training()
+                    return booster
             # checkpoint AFTER this boundary's eval + callbacks so the
             # captured callback state (early_stopping patience) is
             # exactly "everything through this iteration" — resume
@@ -253,7 +290,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                              if valid_sets and i > 0 else []):
                     booster.best_score.setdefault(
                         item[0], {})[item[1]] = item[2]
-            _maybe_checkpoint(force=True)
+            _finish_training()
             return booster
         # fall through to the plain per-iteration loop from iteration i
         start_i = i
@@ -272,22 +309,30 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 begin_iteration=0, end_iteration=num_boost_round,
                 evaluation_result_list=None))
         finished = booster.update(fobj=fobj)
-        evaluation_result_list = []
-        if valid_sets or eval_train_requested:
-            if eval_train_requested:
-                evaluation_result_list.extend(booster.eval_train(feval))
-            evaluation_result_list.extend(booster.eval_valid(feval))
-        try:
-            for cb in callbacks_after:
-                cb(callback_mod.CallbackEnv(
-                    model=booster, params=params, iteration=i,
-                    begin_iteration=0, end_iteration=num_boost_round,
-                    evaluation_result_list=evaluation_result_list))
-        except callback_mod.EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            for item in (e.best_score or []):
-                booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
-            break
+        # eval hoisting: the absolute every-k grid (+ the final and any
+        # stopping iteration), same contract as the batched loop above
+        eval_due = eval_hoist_due(
+            i + 1, i, eval_k, finished or i == num_boost_round - 1)
+        if eval_due:
+            evaluation_result_list = []
+            if valid_sets or eval_train_requested:
+                if eval_train_requested:
+                    evaluation_result_list.extend(
+                        booster.eval_train(feval))
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in callbacks_after:
+                    cb(callback_mod.CallbackEnv(
+                        model=booster, params=params, iteration=i,
+                        begin_iteration=0,
+                        end_iteration=num_boost_round,
+                        evaluation_result_list=evaluation_result_list))
+            except callback_mod.EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                for item in (e.best_score or []):
+                    booster.best_score.setdefault(
+                        item[0], {})[item[1]] = item[2]
+                break
         # checkpoint AFTER eval + callbacks: the captured callback
         # state (early_stopping patience) then covers exactly the
         # iterations the resumed run will not replay
@@ -298,7 +343,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster.best_iteration = booster.current_iteration
         for item in evaluation_result_list if (valid_sets) else []:
             booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
-    _maybe_checkpoint(force=True)
+    _finish_training()
     return booster
 
 

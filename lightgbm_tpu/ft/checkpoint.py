@@ -15,8 +15,13 @@ warning naming the file) and the run falls back to the previous one.
 Resume is BIT-IDENTICAL by construction, not by luck: the state file
 captures every stochastic sequence position the training loop consumes
 
-- the bagging host RNG (MT19937 state) + the current in-bag vector,
-- the GOSS jax key,
+- bagging / GOSS draws are STATELESS since the pipelined-boosting
+  refactor (sample_strategy.py): the indicator at iteration *i* is
+  ``fold_in(PRNGKey(bagging_seed), draw_index(i))``, a pure function of
+  the config and the iteration — nothing to capture, resume recomputes
+  the exact bag (the type is still recorded so a config mismatch fails
+  loudly; pre-refactor v1 checkpoints carried MT19937 state the device
+  draw cannot continue, hence the format-version bump),
 - the learner's feature-fraction RNG and tree counter (extra_trees /
   batched-seed derivation),
 - the device-side quantize tree counter from PR 8 (restored as a fresh
@@ -65,7 +70,10 @@ from ..utils import log
 from ..utils.atomic import fsync_dir, sha256_file as _sha256_file
 from ..utils.retry import retry_call
 
-FORMAT_VERSION = 1
+# v2: bagging/GOSS became stateless device draws (pipelined boosting) —
+# v1 checkpoints carry a host-MT19937 bagging stream position that the
+# fold_in keying cannot continue, so the loader refuses them loudly
+FORMAT_VERSION = 2
 CKPT_PREFIX = "ckpt-"
 TMP_PREFIX = ".ckpt-tmp-"
 _ENV_KEEP = "LIGHTGBM_TPU_CKPT_KEEP"
@@ -180,16 +188,17 @@ def validate_dir(path: str) -> dict:
 # ----------------------------------------------------------------------
 
 def _strategy_state(gbdt) -> Tuple[dict, Optional[np.ndarray]]:
+    """Sampler draws are stateless (fold_in on the iteration index —
+    sample_strategy.py), so only the TYPE is recorded: resume recomputes
+    the exact in-bag vector from (bagging_seed, iter); what must fail
+    loudly is resuming a bagging checkpoint under a bagging-free config
+    (the score bits would silently diverge from the draw sequence)."""
     from ..boosting.sample_strategy import BaggingStrategy, GOSSStrategy
     st = getattr(gbdt, "sample_strategy", None)
     if isinstance(st, BaggingStrategy):
-        bag = None if st._bag is None else np.asarray(st._bag,
-                                                     dtype=np.float32)
-        return {"type": "bagging",
-                "rng": _np_rng_to_json(st.rng)}, bag
+        return {"type": "bagging"}, None
     if isinstance(st, GOSSStrategy):
-        return {"type": "goss",
-                "key": _key_to_json(st._key)}, None
+        return {"type": "goss"}, None
     return {"type": "none"}, None
 
 
@@ -416,26 +425,20 @@ def _parse_model_trees(s: str) -> list:
 
 
 def _restore_strategy(gbdt, state: dict, path: str) -> None:
+    """Type check only — the draws themselves are stateless (fold_in on
+    the iteration index), so the resumed run's first ``bagging`` call at
+    iteration *i* recomputes the exact indicator the uninterrupted run
+    was using (including mid-``bagging_freq``-window resumes)."""
     from ..boosting.sample_strategy import BaggingStrategy, GOSSStrategy
-    import jax.numpy as jnp
     spec = state.get("strategy", {"type": "none"})
     st = getattr(gbdt, "sample_strategy", None)
     kind = spec.get("type", "none")
-    if kind == "bagging":
-        if not isinstance(st, BaggingStrategy):
-            log.fatal("checkpoint %s was written by a bagging run but "
-                      "the resuming config has no bagging" % path)
-        st.rng.set_state(_np_rng_from_json(spec["rng"]))
-        bag_path = os.path.join(path, "bag.npy")
-        if os.path.exists(bag_path):
-            st._bag = jnp.asarray(np.load(bag_path))
-        else:
-            st._bag = None
-    elif kind == "goss":
-        if not isinstance(st, GOSSStrategy):
-            log.fatal("checkpoint %s was written by a GOSS run but the "
-                      "resuming config has no GOSS" % path)
-        st._key = _key_from_json(spec["key"])
+    if kind == "bagging" and not isinstance(st, BaggingStrategy):
+        log.fatal("checkpoint %s was written by a bagging run but "
+                  "the resuming config has no bagging" % path)
+    if kind == "goss" and not isinstance(st, GOSSStrategy):
+        log.fatal("checkpoint %s was written by a GOSS run but the "
+                  "resuming config has no GOSS" % path)
 
 
 def _restore_learner(gbdt, state: dict) -> None:

@@ -203,6 +203,7 @@ class DataParallelTreeLearner(CapabilityMixin):
         self._many_fn = None
         self._many_multi_fn = None
         self._many_grad_fn = None
+        self._many_sample = None
         return cols_host
 
     def _make_cegb_fetched(self, rows: int) -> jnp.ndarray:
@@ -804,26 +805,32 @@ class DataParallelTreeLearner(CapabilityMixin):
         stochastic-rounding key folds in from a scan-carried device
         counter, and the scan's ``alive`` flag freezes the score after
         a stump step — a later redraw can no longer grow a tree the
-        host never applies."""
+        host never applies. extra_trees batches under the same alive
+        treatment: its per-node rand_bins key on the scanned per-tree
+        seed, the exact sequence the looped path derives from
+        ``_tree_idx``."""
         return (not self._cegb_enabled
                 and self._mono_tracker is None
                 and not self._needs_per_node_masks()
-                and not self._extra_trees  # per-seed rand_bins would
-                # need the same alive-flag treatment; still gated
                 and not (0.0 < float(self.config.feature_fraction) < 1.0))
 
-    def _make_gh_traced(self, grad, hess):
+    def _make_gh_traced(self, grad, hess, ind=None):
         """_make_gh without the device_put (inside jit the sharding is a
-        constraint, not a transfer)."""
+        constraint, not a transfer). ``ind`` is the in-bag indicator,
+        None for all-rows — the same masked staging the looped
+        ``_make_gh`` performs."""
         ones = jnp.ones(self.N, dtype=jnp.float32)
-        gh = jnp.stack([grad, hess, ones, ones], axis=1)
+        if ind is None:
+            gh = jnp.stack([grad, hess, ones, ones], axis=1)
+        else:
+            gh = jnp.stack([grad * ind, hess * ind, ind, ones], axis=1)
         if self.R - self.N:
             gh = jnp.concatenate(
                 [gh, jnp.zeros((self.R - self.N, 4), dtype=jnp.float32)],
                 axis=0)
         return jax.lax.with_sharding_constraint(gh, self.gh_sharding)
 
-    def _make_gh_quantized_traced(self, grad, hess, key):
+    def _make_gh_quantized_traced(self, grad, hess, ind, key):
         """_make_gh_quantized inside the batched scan: the stochastic
         draw runs on the UNPADDED [N] rows with the scan-carried
         fold-in key (bit-identical to the looped path's per-tree
@@ -834,8 +841,9 @@ class DataParallelTreeLearner(CapabilityMixin):
         integers."""
         from ..ops.quantize import _quantize_gh
         barrier = jax.lax.optimization_barrier
-        ones = jnp.ones(self.N, dtype=jnp.float32)
-        gh, qscale = barrier(_quantize_gh(grad, hess, ones, key,
+        if ind is None:
+            ind = jnp.ones(self.N, dtype=jnp.float32)
+        gh, qscale = barrier(_quantize_gh(grad, hess, ind, key,
                                           self._qmax, self._qdtype))
         if self.R - self.N:
             gh = jnp.concatenate(
@@ -874,21 +882,37 @@ class DataParallelTreeLearner(CapabilityMixin):
         outs = self._leaf_outputs_from_records(recs) * lr
         return recs, outs[state.leaf_of_row[:self.N]]
 
-    def _step_gh(self, grad, hess, qkey, ctr):
+    def _step_gh(self, grad, hess, ind, qkey, ctr):
         """Per-tree gh staging inside the scan: exact f32 rows, or —
         quantized — advance the scan-carried tree counter and draw
         with its fold-in key (the looped path's ops/quantize.tree_key
-        sequence, bit-exact). Returns (gh, qscale, ctr)."""
+        sequence, bit-exact). ``ind`` is the iteration's in-bag
+        indicator (None for all rows). Returns (gh, qscale, ctr)."""
         barrier = jax.lax.optimization_barrier
         if qkey is None:
-            return (barrier(self._make_gh_traced(grad, hess)),
+            return (barrier(self._make_gh_traced(grad, hess, ind)),
                     self._qs_ones, ctr)
         ctr = ctr + jnp.uint32(1)
         gh, qscale = self._make_gh_quantized_traced(
-            grad, hess, jax.random.fold_in(qkey, ctr))
+            grad, hess, ind, jax.random.fold_in(qkey, ctr))
         return gh, qscale, ctr
 
-    def _many_impl(self, bins, score0, seeds, feature_mask, lr,
+    def _apply_sampling(self, iter_idx, grad, hess):
+        """The sample strategy's draw inside the scan
+        (``apply_traced``): bagging indicators / GOSS rescales keyed on
+        the traced iteration index — the fold_in sequence the looped
+        path's ``bagging`` dispatches one iteration at a time. The
+        barrier pins the outputs at what is a dispatch boundary on the
+        looped path."""
+        strat = self._many_sample
+        if strat is None:
+            return grad, hess, None
+        g, h, ind = strat.apply_traced(iter_idx, grad, hess)
+        if ind is None:
+            return g, h, None
+        return jax.lax.optimization_barrier((g, h, ind))
+
+    def _many_impl(self, bins, score0, seeds, iters, feature_mask, lr,
                    qkey=None, qctr0=None):
         # optimization_barrier at every boundary that is a separate
         # dispatch in the per-iteration path: without them XLA fuses the
@@ -896,11 +920,13 @@ class DataParallelTreeLearner(CapabilityMixin):
         # and the batched trees drift bit-wise from the looped ones
         barrier = jax.lax.optimization_barrier
 
-        def step(carry, seed):
+        def step(carry, xs):
+            seed, it = xs
             # score [N] (single-model objectives)
             score, ctr, alive = carry
             grad, hess = barrier(self._many_grad_fn(score))
-            gh, qscale, ctr = self._step_gh(grad, hess, qkey, ctr)
+            grad, hess, ind = self._apply_sampling(it, grad, hess)
+            gh, qscale, ctr = self._step_gh(grad, hess, ind, qkey, ctr)
             recs, delta = self._grow_one(bins, gh, feature_mask, seed,
                                          lr, qscale)
             grew = rec_valid(jax.tree_util.tree_map(
@@ -916,25 +942,29 @@ class DataParallelTreeLearner(CapabilityMixin):
 
         ctr0 = jnp.uint32(0) if qctr0 is None else qctr0
         carry = (score0, ctr0, jnp.asarray(True))
-        (score, ctr, _), recs = jax.lax.scan(step, carry, seeds)
+        (score, ctr, _), recs = jax.lax.scan(step, carry, (seeds, iters))
         return (score, ctr), recs
 
-    def _many_impl_multi(self, bins, score0, seeds, feature_mask, lr,
-                         qkey=None, qctr0=None):
+    def _many_impl_multi(self, bins, score0, seeds, iters, feature_mask,
+                         lr, qkey=None, qctr0=None):
         # K trees per iteration (multiclass): one gradient pass per step
         # over the [N, K] scores, then a statically unrolled per-class
         # tree (reference: the k-loop of GBDT::TrainOneIter)
         barrier = jax.lax.optimization_barrier
         K = int(seeds.shape[1])
 
-        def step(carry, seeds_k):
+        def step(carry, xs):
+            seeds_k, it = xs
             score, ctr, alive = carry
             grad, hess = barrier(self._many_grad_fn(score))
+            # one sampling draw per ITERATION over the [N, K] columns —
+            # the looped path draws before its per-class loop too
+            grad, hess, ind = self._apply_sampling(it, grad, hess)
             all_recs = []
             grew = jnp.asarray(False)
             for k in range(K):
                 gh, qscale, ctr = self._step_gh(grad[:, k], hess[:, k],
-                                                qkey, ctr)
+                                                ind, qkey, ctr)
                 recs, delta = self._grow_one(bins, gh, feature_mask,
                                              seeds_k[k], lr, qscale)
                 grew = grew | rec_valid(jax.tree_util.tree_map(
@@ -950,45 +980,55 @@ class DataParallelTreeLearner(CapabilityMixin):
 
         ctr0 = jnp.uint32(0) if qctr0 is None else qctr0
         carry = (score0, ctr0, jnp.asarray(True))
-        (score, ctr, _), recs = jax.lax.scan(step, carry, seeds)
+        (score, ctr, _), recs = jax.lax.scan(step, carry, (seeds, iters))
         return (score, ctr), recs
 
-    def train_many(self, grad_fn, score0: jnp.ndarray, seeds,
-                   shrinkage: float):
+    def train_many(self, grad_fn, sample_strategy, score0: jnp.ndarray,
+                   seeds, iters, shrinkage: float):
         """Run T boosting iterations in one dispatch. ``seeds`` is [T]
         (single-model objectives; ``score0`` is the [N] score column)
-        or [T, K] (K trees per iteration; ``score0`` is [N, K]).
-        Returns (final scores, stacked SplitRecords [T, (K,) L-1]) —
-        the record read-back is the batch's single host sync.
-        ``grad_fn`` must be traceable (the objective's jitted gradient
-        fn). Quantized mode threads the learner's device-side tree
-        counter through the scan and stores its advanced value back,
-        so a later looped tree draws the key the looped path would
-        have drawn."""
+        or [T, K] (K trees per iteration; ``score0`` is [N, K]);
+        ``iters`` is the [T] vector of absolute iteration numbers (the
+        sample strategy's draw index). Returns (final scores, stacked
+        SplitRecords [T, (K,) L-1]) — the record read-back is the
+        batch's single host sync. ``grad_fn`` must be traceable (the
+        objective's jitted gradient fn); ``sample_strategy`` provides
+        the traceable ``apply_traced`` draw (None for no sampling).
+        Quantized mode threads the learner's device-side tree counter
+        through the scan and stores its advanced value back, so a
+        later looped tree draws the key the looped path would have
+        drawn."""
         self._ensure_compiled()
-        seeds = jnp.asarray(np.asarray(seeds, dtype=np.int32))
+        # explicit staging of the batch's control vectors (the
+        # transfer-guard sanitizer pins the warmed batch clean)
+        seeds = jax.device_put(np.asarray(seeds, dtype=np.int32))
+        iters = jax.device_put(np.asarray(iters, dtype=np.int32))
         # bound methods are rebuilt per attribute access: compare by
         # equality (__self__/__func__), not identity, or every batch
-        # would re-jit the scan
-        if self._many_fn is None or self._many_grad_fn != grad_fn:
+        # would re-jit the scan; strategies compare by value the same
+        # way (sample_strategy.py _jit_key)
+        if self._many_fn is None or self._many_grad_fn != grad_fn \
+                or self._many_sample != sample_strategy:
             self._many_grad_fn = grad_fn
+            self._many_sample = sample_strategy
             self._many_fn = obs_compile.instrument_jit(
                 "mesh.train_many", self._many_impl)
             self._many_multi_fn = obs_compile.instrument_jit(
                 "mesh.train_many_multi", self._many_impl_multi)
         feature_mask = self._sample_features()
         self._tree_idx += int(seeds.size)
+        from ..utils.scalars import dev_f32
+        lr = dev_f32(float(shrinkage))
         fn = self._many_multi_fn if seeds.ndim == 2 else self._many_fn
         if self._quantized:
-            out, recs = fn(self.bins, score0, seeds, feature_mask,
-                           jnp.float32(shrinkage),
-                           self._quant_base_key, self._quant_ctr)
+            out, recs = fn(self.bins, score0, seeds, iters, feature_mask,
+                           lr, self._quant_base_key, self._quant_ctr)
             score_t, self._quant_ctr = out
             # the scan advanced the device counter once per tree slot;
             # keep the host mirror (the _quantize_stage assert) in step
             self._quant_ctr_host += int(seeds.size)
         else:
-            out, recs = fn(self.bins, score0, seeds, feature_mask,
-                           jnp.float32(shrinkage))
+            out, recs = fn(self.bins, score0, seeds, iters, feature_mask,
+                           lr)
             score_t = out[0]
         return score_t, recs

@@ -494,6 +494,16 @@ class ShardedTreeLearner(CapabilityMixin):
         # reference)
         self._K = max(1, min(
             int(getattr(config, "tpu_frontier_splits", 8)), self.L - 1))
+        # cross-ITERATION prefetch scheduling (pipelined boosting): a
+        # sweep started but unconsumed when tree t's grow loop ends —
+        # or started deliberately at the end of train() — is stashed
+        # here, so shard 0 of tree t+1's ROOT sweep stages while the
+        # boosting layer runs t's score update and t+1's gradients /
+        # gh staging. The stash is always a FRESH (never-iterated)
+        # sweep: prestarted sweeps are consumed from the top or not at
+        # all, so the ordered-accumulation bit-parity contract is
+        # untouched.
+        self._next_sweep = None
         self._rebind_compiled()
 
     def _rebind_compiled(self) -> None:
@@ -578,8 +588,27 @@ class ShardedTreeLearner(CapabilityMixin):
         else:
             leaf_segs = self._grow_stepped(tree, gh, gh_segs, leaf_segs,
                                            feature_mask, rand_seed)
+        if self._next_sweep is None:
+            # schedule the NEXT iteration's root sweep across the
+            # boosting boundary: shard 0 stages while the caller runs
+            # this tree's score update and the next tree's gradients +
+            # gh staging (the last training iteration wastes one
+            # worker-side staging — the same accepted cost as the
+            # grow loops' early-stop prestarts)
+            self._next_sweep = self.prefetcher.sweep()
         rows_out = _rows_out_fn_cached(tuple(self._sizes))
         return tree, rows_out(*leaf_segs)
+
+    # ------------------------------------------------------------------
+    def release_prefetch(self) -> None:
+        """Drop the cross-iteration sweep stash. Called by the boosting
+        layer when a training run ends: the parked sweep pins one
+        staged shard buffer in device memory, which is paid-for
+        overlap DURING training but dead weight once no further tree
+        will consume it. Correctness is unaffected — the next
+        ``_root_round`` (continued training) simply starts a fresh
+        sweep."""
+        self._next_sweep = None
 
     # ------------------------------------------------------------------
     def _root_round(self, gh, gh_segs, feature_mask, rand_seed):
@@ -592,7 +621,11 @@ class ShardedTreeLearner(CapabilityMixin):
         chosen record (stepped) or the top-K speculation (K-batch).
         Returns (state, recs_dev, recs_host, pending_sweep)."""
         hist = self._zero_hist()
-        for k, bins_dev in self.prefetcher.sweep():
+        # the previous iteration stashed this tree's root sweep at its
+        # own end (cross-iteration prefetch scheduling; train() above)
+        root_sweep, self._next_sweep = (
+            self._next_sweep or self.prefetcher.sweep(), None)
+        for k, bins_dev in root_sweep:
             hist = _accum_hist_fn(hist, bins_dev, gh_segs[k])
         sums_raw = _sum_gh_fn(gh)
         state, rec = self._root_fn(
@@ -651,6 +684,9 @@ class ShardedTreeLearner(CapabilityMixin):
             apply_split_record(tree, self.dataset, rec_h)
             next_leaf += 1
             rec, rec_h = next_rec, next_rec_h
+        # a prestarted-but-unconsumed sweep (early stop) is a fresh full
+        # sweep — exactly the next iteration's root sweep; stash it
+        self._next_sweep = pending
         return leaf_segs
 
     # ------------------------------------------------------------------
@@ -741,6 +777,9 @@ class ShardedTreeLearner(CapabilityMixin):
             rev = _revert_fn_cached(K)
             for k in range(len(leaf_segs)):
                 leaf_segs[k] = rev(leaf_segs[k], rf_dev, rt_dev)
+        # stash a prestarted-but-unconsumed sweep for the next
+        # iteration's root (same as the stepped path)
+        self._next_sweep = pending
         return leaf_segs
 
 

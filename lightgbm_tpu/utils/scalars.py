@@ -36,6 +36,13 @@ def dev_u32(x: int):
     return jax.device_put(np.uint32(x))
 
 
+@functools.lru_cache(maxsize=65536)
+def dev_f32(x: float):
+    """Device float32 scalar via explicit transfer, cached per value
+    (shrinkage rates and fixed fractions repeat across iterations)."""
+    return jax.device_put(np.float32(x))
+
+
 @functools.lru_cache(maxsize=2)
 def dev_bool(x: bool):
     """Device bool scalar via explicit transfer (two cached values)."""

@@ -289,7 +289,9 @@ class TestQuantizedBatched:
     @pytest.mark.parametrize("extra", [
         {"use_quantized_grad": True},
         {"use_quantized_grad": True, "quant_grad_bits": 16},
-    ], ids=["quantized8", "quantized16"])
+        {"use_quantized_grad": True,
+         "bagging_fraction": 0.7, "bagging_freq": 1},
+    ], ids=["quantized8", "quantized16", "quantized8-bagging"])
     def test_batched_matches_looped(self, extra):
         a, X, y = _make_mesh_booster(extra)
         b, _, _ = _make_mesh_booster(extra)

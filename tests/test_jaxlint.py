@@ -671,13 +671,44 @@ class TestTransferGuardSanitizer:
         {"objective": "regression", "num_leaves": 7,
          "use_quantized_grad": True},
         {"objective": "multiclass", "num_class": 3, "num_leaves": 7},
-    ], ids=["binary", "regression", "quantized8", "multiclass"])
+        {"objective": "binary", "num_leaves": 7,
+         "bagging_fraction": 0.7, "bagging_freq": 1},
+    ], ids=["binary", "regression", "quantized8", "multiclass",
+            "bagging"])
     def test_train_iteration_no_implicit_transfers(self, params):
+        # bagging rides the matrix since the pipelined-boosting
+        # refactor: the in-bag draw is one jitted device dispatch
+        # (boost.bag_draw), no host RNG and no per-iteration bag
+        # transfer left in the loop
         import jax
         booster = _train_warm(params)
         with jax.transfer_guard("disallow"):
             booster.train_one_iter()
         assert booster.iter == 3
+
+    @pytest.mark.parametrize("params", [
+        {"objective": "binary", "num_leaves": 7,
+         "bagging_fraction": 0.7, "bagging_freq": 1},
+        {"objective": "binary", "num_leaves": 7,
+         "use_quantized_grad": True,
+         "bagging_fraction": 0.7, "bagging_freq": 1},
+    ], ids=["batched-exact-bagging", "batched-quantized8-bagging"])
+    def test_batched_step_no_implicit_transfers(self, params):
+        """ISSUE 13 satellite: a warmed BATCHED multi-iteration step
+        (train_batch -> train_many scan) under the guard. With the
+        gradient pass, the bagging draw, gh staging/quantization and
+        the score update all folded into the scan, the only transfers
+        per batch are the explicit seed/iteration staging
+        (device_put), the utils/scalars device scalars, and the single
+        deliberate record read-back (device_get)."""
+        import jax
+        booster = _train_warm(dict(params, tree_learner="data",
+                                   mesh_shape="data=1"))
+        assert booster.can_train_batched()
+        booster.train_batch(2)          # warm the scan compile
+        with jax.transfer_guard("disallow"):
+            booster.train_batch(2)
+        assert booster.iter == 6
 
     @pytest.mark.parametrize("params", [
         {"objective": "binary", "num_leaves": 7},

@@ -246,6 +246,45 @@ class TestShardPrefetcher:
             assert (host[:, ds.num_features:] == 0).all()
         pf.close()
 
+    def test_cross_iteration_prefetch_scheduling(self, tmp_path):
+        """Pipelined boosting (ISSUE 13): when tree t's grow loop ends,
+        the learner stashes a fresh sweep so shard 0 of tree t+1's
+        ROOT sweep stages across the boosting boundary (score update +
+        gradients + gh staging) instead of after it. The stash must be
+        consumed — not duplicated — so steady-state stagings per
+        iteration are flat, and the trees stay bit-identical to the
+        in-memory learner (the ordered-accumulation contract is
+        untouched because stashed sweeps are never partially
+        consumed)."""
+        X, y = _data()
+        params = dict(BASE, num_leaves=7)
+        ds = ShardedBinnedDataset.from_chunk_source(
+            _source(X, y, chunk=250), Config.from_params(dict(params)),
+            str(tmp_path / "sh"), shard_rows=250, total_rows=1000)
+        booster = create_boosting(
+            Config.from_params(dict(params, num_iterations=4)), ds)
+        registry.reset()
+        per_iter = []
+        for _ in range(4):
+            before = registry.count("io/shards_staged")
+            booster.train_one_iter()
+            per_iter.append(registry.count("io/shards_staged") - before)
+            # a sweep is parked for the next iteration's root
+            assert booster.learner._next_sweep is not None
+        # iteration 1 pays the stashed sweep's staging at its own end;
+        # from then on every iteration consumes one stash and parks one
+        # — the per-iteration staging cost is flat (no duplicated root
+        # sweeps, no leaked prestarts)
+        assert per_iter[1] == per_iter[2] == per_iter[3]
+        b_mem = create_boosting(
+            Config.from_params(dict(params, num_iterations=4)),
+            BinnedDataset.from_matrix(
+                X, Config.from_params(dict(params)), label=y))
+        for _ in range(4):
+            b_mem.train_one_iter()
+        assert booster.save_model_to_string() \
+            == b_mem.save_model_to_string()
+
     def test_small_shard_counts_cached_resident(self, tmp_path,
                                                 monkeypatch):
         """<=2 shards fit the double buffer anyway: staged once, served
