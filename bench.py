@@ -305,8 +305,11 @@ def run_stream_smoke() -> dict:
     through the streaming spool. Proves the unbounded-length contract:
     bounded RSS while segments rotate, every segment validating, and
     the whole directory merging into one Perfetto file via
-    tools/trace_report.py. First-class keys: ``trace_segments_written``
-    and ``trace_dropped_events``."""
+    tools/trace_report.py. First-class keys: ``trace_segments_written``,
+    ``trace_dropped_events``, ``trace_bytes_per_event`` (on-disk cost
+    of the run's format), and ``trace_compact_shrink_x`` (how much the
+    compact binary format of obs/trace_compact.py shrinks the heaviest
+    JSON segment, verified lossless by re-decoding)."""
     import importlib.util
     import resource
     import tempfile
@@ -376,9 +379,31 @@ def run_stream_smoke() -> dict:
     obs_trace.configure_stream(None)
     obs_registry.disable()
     obs_registry.timer.sampling = False
+
+    # disk cost of what this run actually wrote, and how much the
+    # compact codec would shrink the heaviest JSON segment (losslessly —
+    # the round-trip is asserted, not assumed)
+    seg_files = trace_report.segment_files(out_dir)
+    disk_bytes = sum(os.path.getsize(f) for f in seg_files)
+    bytes_per_event = round(disk_bytes / max(emitted, 1), 2)
+    shrink_x = None
+    json_segs = [f for f in seg_files if f.endswith(".json")]
+    if json_segs:
+        from lightgbm_tpu.obs import trace_compact
+        heaviest = max(json_segs, key=os.path.getsize)
+        doc = trace_report.load_file(heaviest)
+        compact = trace_compact.encode_events(
+            doc["traceEvents"], doc.get("otherData") or {})
+        hdr, back = trace_compact.decode_segment(compact)
+        lossless = (back == [trace_compact._normalize(e)
+                             for e in doc["traceEvents"]])
+        if lossless:
+            shrink_x = round(os.path.getsize(heaviest) / len(compact), 2)
     _stage("stream_done", validate_errors=len(errors),
            merged_events=len(merged["traceEvents"]),
-           merge_errors=len(merge_ok))
+           merge_errors=len(merge_ok),
+           trace_bytes_per_event=bytes_per_event,
+           trace_compact_shrink_x=shrink_x)
     return {
         "metric": "trace_stream_events_per_sec",
         "value": round(emitted / max(emit_secs, 1e-9), 1),
@@ -391,6 +416,8 @@ def run_stream_smoke() -> dict:
         "trace_events_emitted": emitted,
         "trace_segments_written": segments,
         "trace_dropped_events": dropped,
+        "trace_bytes_per_event": bytes_per_event,
+        "trace_compact_shrink_x": shrink_x,
         "rss_mb": rss_peak,
         "validate_ok": not errors,
         "merge_ok": not merge_ok,

@@ -20,11 +20,20 @@ at exit under -DUSE_TIMETAG). This package is the TPU-native superset:
   snapshot stream, one ``health`` event per breach).
 - :mod:`export`    — OpenMetrics-style snapshot rendering: periodic
   file dumps (``LIGHTGBM_TPU_METRICS=path``) and the HTTP ``/metrics``
-  listener the serving plane mounts.
+  listener the serving plane mounts (text-format primitives live in
+  the stdlib-pure :mod:`openmetrics`).
+- :mod:`gateway`   — the FLEET plane: per-process
+  :class:`~lightgbm_tpu.obs.gateway.SnapshotPusher` POSTs
+  (``LIGHTGBM_TPU_METRICS_GATEWAY=url``) into one
+  :class:`~lightgbm_tpu.obs.gateway.MetricsGateway` serving aggregated
+  ``{rank=,process=}`` metrics + per-rank push staleness, watched by
+  ``health.fleet_rules`` (rank_skew / dead_rank / fleet_shed_rate).
 - :mod:`trace`     — span tracing layered onto the scopes and events
   above, exported as Chrome-trace/Perfetto JSON
   (``LIGHTGBM_TPU_TRACE=path.json``), with the async readiness drainer
-  that replaces stage fences under ``LIGHTGBM_TPU_TIMETAG=sample``.
+  that replaces stage fences under ``LIGHTGBM_TPU_TIMETAG=sample``;
+  streaming runs can write the compact binary segment format of
+  :mod:`trace_compact` (``LIGHTGBM_TPU_TRACE_FORMAT=compact``).
 
 Enable stage timing with ``LIGHTGBM_TPU_TIMETAG=1`` (the analogue of
 -DUSE_TIMETAG; fencing) or ``=sample`` (non-perturbing) or
@@ -36,9 +45,11 @@ from __future__ import annotations
 
 from . import compile as compile_tracking  # noqa: F401
 from . import events, faults, health  # noqa: F401
+from . import openmetrics, trace_compact  # noqa: F401  (stdlib-pure)
 from .registry import MetricsRegistry, StageTimer, registry  # noqa: F401
 from . import trace  # noqa: F401  (installs the span hooks/taps)
 from . import export  # noqa: F401  (OpenMetrics snapshots + /metrics)
+from . import gateway  # noqa: F401  (fleet push gateway)
 
 scope = registry.scope
 counter = registry.inc
@@ -48,6 +59,7 @@ watch_ready = registry.watch_ready
 
 __all__ = [
     "MetricsRegistry", "StageTimer", "registry", "events", "health",
-    "compile_tracking", "trace", "export", "scope", "counter", "gauge",
+    "compile_tracking", "trace", "trace_compact", "openmetrics",
+    "export", "gateway", "scope", "counter", "gauge",
     "observe", "watch_ready",
 ]

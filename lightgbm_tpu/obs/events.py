@@ -2,7 +2,11 @@
 
 Every event is one JSON object per line::
 
-    {"ts": 1722700000.123, "event": "train_iter", "iter": 4, ...}
+    {"ts": 1722700000.123, "event": "train_iter",
+     "run_id": "18f2a-4c1", "iter": 4, ...}
+
+(``run_id`` — see :func:`run_id` — correlates every record of one run
+across processes: ranks inherit ``LIGHTGBM_TPU_RUN_ID``.)
 
 Two sinks, both optional and independent:
 
@@ -38,6 +42,10 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 _ENV_VAR = "LIGHTGBM_TPU_EVENT_LOG"
 _ENV_BUFFER = "LIGHTGBM_TPU_EVENT_BUFFER"
+_ENV_RUN_ID = "LIGHTGBM_TPU_RUN_ID"
+
+_run_id: Optional[str] = None
+_run_id_env: Optional[str] = None
 
 _callback: Optional[Callable[[Dict], None]] = None
 _path_override: Optional[str] = None
@@ -56,6 +64,30 @@ def install_trace_tap(active_fn: Callable[[], bool],
                       note_fn: Callable[[Dict], None]) -> None:
     global _trace_tap
     _trace_tap = (active_fn, note_fn)
+
+
+def run_id() -> str:
+    """The run-correlation id stamped into every event record, trace
+    segment header, and gateway push. ``LIGHTGBM_TPU_RUN_ID`` wins when
+    set (re-read per call, so a late assignment — or a test
+    monkeypatch — takes effect); otherwise one id is generated on
+    first use and WRITTEN BACK to the environment, so subprocesses
+    spawned after that point (dtrain ranks, serve workers) inherit the
+    parent's id and the whole fleet's telemetry joins on one key
+    (``tools/trace_report.py fleet``)."""
+    global _run_id, _run_id_env
+    env = os.environ.get(_ENV_RUN_ID)
+    if env:
+        if env != _run_id_env:
+            _run_id_env = env
+            _run_id = env
+        return env
+    if _run_id is None:
+        _run_id = "%x-%x" % (int(time.time() * 1e3) & 0xFFFFFFFFFF,
+                             os.getpid())
+        _run_id_env = _run_id
+        os.environ[_ENV_RUN_ID] = _run_id
+    return _run_id
 
 
 def _buffer_limit() -> int:
@@ -126,7 +158,8 @@ def emit(event: str, **fields) -> Optional[Dict]:
     must not take training down."""
     if not enabled():
         return None
-    rec = {"ts": round(time.time(), 6), "event": event}
+    rec = {"ts": round(time.time(), 6), "event": event,
+           "run_id": run_id()}
     for k, v in fields.items():
         rec[k] = _jsonable(v)
     cb = _callback

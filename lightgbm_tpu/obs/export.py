@@ -25,6 +25,12 @@ it somewhere a consumer can reach:
   mounts it with ``metrics_port=...`` so a serving fleet is scrapable
   under load.
 
+Multi-process fleets push instead of being scraped per process:
+``LIGHTGBM_TPU_METRICS_GATEWAY=url`` makes :func:`tick` start one
+:class:`obs.gateway.SnapshotPusher` POSTing this renderer's text to a
+:class:`obs.gateway.MetricsGateway`, which serves the whole fleet as
+ONE aggregated ``/metrics`` with ``{rank=,process=}`` labels.
+
 Everything here is best-effort and never raises into the caller:
 telemetry must not take training or serving down.
 """
@@ -36,28 +42,26 @@ import os
 import re
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 from . import events as _events
 from .registry import registry
 from ..utils import log
+# the text-format layer (escaping, sample lines, strict parsing) lives
+# in obs/openmetrics.py — stdlib-pure so the gateway and the no-jax
+# tools share it; re-exported here so existing `from obs.export import
+# parse_openmetrics` call sites are unchanged
+from .openmetrics import (  # noqa: F401  (re-exports)
+    Sample, kPrefix, metric_value, parse_openmetrics,
+    parse_type_headers, _esc, _fmt, _lbl, _san)
 
 _ENV_PATH = "LIGHTGBM_TPU_METRICS"
 _ENV_INTERVAL = "LIGHTGBM_TPU_METRICS_INTERVAL"
 _ENV_WATCHDOG = "LIGHTGBM_TPU_WATCHDOG"
 
-kPrefix = "lightgbm_tpu_"
 kDefaultIntervalS = 10.0
 
-_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 _REPLICA_RE = re.compile(r"^(.*)/replica/(\d+)(?:/model/(.+))?$")
-
-
-def _san(name: str) -> str:
-    s = _NAME_RE.sub("_", name)
-    if not s or s[0].isdigit():
-        s = "_" + s
-    return s
 
 
 def _split_replica(name: str):
@@ -74,27 +78,6 @@ def _split_replica(name: str):
     if m.group(3) is not None:
         labels.append(("model", m.group(3)))
     return m.group(1), tuple(sorted(labels))
-
-
-def _esc(label_value: str) -> str:
-    return (str(label_value).replace("\\", "\\\\").replace('"', '\\"')
-            .replace("\n", "\\n"))
-
-
-def _fmt(v) -> str:
-    f = float(v)
-    if f == int(f) and abs(f) < 1e15:
-        return str(int(f))
-    return repr(f)
-
-
-def _lbl(labels, extra=()) -> str:
-    """Render a ``{k="v",...}`` label block (empty string when there
-    are no labels)."""
-    pairs = list(labels or ()) + list(extra)
-    if not pairs:
-        return ""
-    return "{%s}" % ",".join('%s="%s"' % (k, _esc(v)) for k, v in pairs)
 
 
 def render_openmetrics(reg=registry) -> str:
@@ -205,50 +188,6 @@ def render_openmetrics(reg=registry) -> str:
     return "\n".join(out) + "\n"
 
 
-_SAMPLE_RE = re.compile(
-    r'^([a-zA-Z_][a-zA-Z0-9_]*)(?:\{(.*)\})?\s+(\S+)\s*$')
-_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
-
-Sample = Tuple[str, Tuple[Tuple[str, str], ...]]
-
-
-def parse_openmetrics(text: str) -> Dict[Sample, float]:
-    """Parse OpenMetrics-style text back into
-    ``{(name, ((label, value), ...)): float}``. Raises ValueError on a
-    malformed sample line — the round-trip tests depend on strictness."""
-    out: Dict[Sample, float] = {}
-    for line in text.splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        m = _SAMPLE_RE.match(line)
-        if m is None:
-            raise ValueError("malformed sample line: %r" % line)
-        name, labels_raw, value = m.groups()
-        labels = []
-        if labels_raw:
-            matched = _LABEL_RE.findall(labels_raw)
-            stripped = _LABEL_RE.sub("", labels_raw).replace(",", "").strip()
-            if stripped:
-                raise ValueError("malformed labels: %r" % labels_raw)
-            # single left-to-right scan: sequential .replace() passes
-            # would let an escaped backslash donate its second half to
-            # a following 'n' or '"' (r'C:\\nightly' -> 'C:\' + \n)
-            unesc = re.compile(r"\\(.)")
-            labels = [(k, unesc.sub(
-                lambda m: "\n" if m.group(1) == "n" else m.group(1), v))
-                for k, v in matched]
-        out[(name, tuple(sorted(labels)))] = float(value)
-    return out
-
-
-def metric_value(parsed: Dict[Sample, float], name: str,
-                 **labels) -> Optional[float]:
-    """Convenience lookup into :func:`parse_openmetrics` output."""
-    key = (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
-    return parsed.get(key)
-
-
 def dump_metrics(path: str, reg=registry) -> None:
     """One-shot atomic snapshot dump. Never raises: transient write
     failures retry with bounded backoff (utils/retry.py), and a dump
@@ -334,17 +273,20 @@ class SnapshotExporter:
 
 
 _exporter: Optional[SnapshotExporter] = None
+_pusher = None  # gateway SnapshotPusher singleton (obs/gateway.py)
 _inline_watchdog = None
 _lock = threading.Lock()
 
 
 def tick(reg=registry) -> None:
     """Per-iteration hook (called from ``obs/trace.sample_iteration``):
-    starts the env-configured exporter once, and — when no file
+    starts the env-configured exporter once, the env-configured fleet
+    gateway pusher once (``LIGHTGBM_TPU_METRICS_GATEWAY=url`` — the
+    training-side half of the obs/gateway.py plane), and — when no file
     exporter is running but ``LIGHTGBM_TPU_WATCHDOG`` asks for it —
     evaluates the default watchdog inline so event-log-only runs still
-    get ``health`` events. Cheap when neither env var is set."""
-    global _exporter, _inline_watchdog
+    get ``health`` events. Cheap when none of the env vars is set."""
+    global _exporter, _pusher, _inline_watchdog
     path = os.environ.get(_ENV_PATH)
     if path and _exporter is None:
         with _lock:
@@ -356,6 +298,13 @@ def tick(reg=registry) -> None:
                     interval = kDefaultIntervalS
                 _exporter = SnapshotExporter(path, interval,
                                              reg).start()
+    gw_url = os.environ.get("LIGHTGBM_TPU_METRICS_GATEWAY")
+    if gw_url and _pusher is None:
+        with _lock:
+            if _pusher is None:
+                from .gateway import SnapshotPusher
+                _pusher = SnapshotPusher(gw_url, reg=reg,
+                                         role="train").start()
     if _exporter is not None:
         return
     wd = os.environ.get(_ENV_WATCHDOG, "")
@@ -373,12 +322,16 @@ def tick(reg=registry) -> None:
 
 
 def reset_exporter() -> None:
-    """Detach the env-driven exporter/watchdog singletons (tests)."""
-    global _exporter, _inline_watchdog
+    """Detach the env-driven exporter/pusher/watchdog singletons
+    (tests)."""
+    global _exporter, _pusher, _inline_watchdog
     with _lock:
         if _exporter is not None:
             _exporter.stop()
+        if _pusher is not None:
+            _pusher.stop()
         _exporter = None
+        _pusher = None
         _inline_watchdog = None
 
 

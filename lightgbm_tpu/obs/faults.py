@@ -17,6 +17,7 @@ registry_swap      serve ModelRegistry.publish AND canary promote
 checkpoint_finalize ft/checkpoint.py directory finalize (rename)
 serve_admit        PredictServer.submit admission (request intake)
 serve_dispatch     PredictServer worker dispatch (predictor.predict)
+gateway_push       SnapshotPusher metrics POST (obs/gateway.py)
 ================== ====================================================
 
 A schedule is a ``;``-separated spec string (``LIGHTGBM_TPU_FAULTS``
@@ -57,7 +58,8 @@ _ENV = "LIGHTGBM_TPU_FAULTS"
 
 SITES = ("shard_open", "prefetch_device_put", "spill_write",
          "trace_finalize", "metrics_dump", "registry_swap",
-         "checkpoint_finalize", "serve_admit", "serve_dispatch")
+         "checkpoint_finalize", "serve_admit", "serve_dispatch",
+         "gateway_push")
 
 
 class InjectedFault(OSError):

@@ -305,6 +305,114 @@ def default_rules() -> List[WatchRule]:
             WatchRule("breaker_open", breaker_open)]
 
 
+def fleet_rules() -> List[WatchRule]:
+    """Watchdog rules over the GATEWAY's aggregated fleet snapshot
+    (``obs.gateway.MetricsGateway.fleet_snapshot``: one entry per
+    pushing (rank, process) source with push age + pre-extracted
+    aggregates), evaluated at the gateway on every push and every
+    ``/healthz`` scrape. Same :class:`Watchdog` once-per-breach +
+    re-arm contract as the per-process rules. Thresholds:
+
+    - ``LIGHTGBM_TPU_WATCH_RANK_SKEW`` (default 2.0): slowest/fastest
+      rank ratio of summed stage seconds at or above this = one rank
+      is dragging the synchronous collective loop (every other rank
+      waits at the allreduce — the whole fleet runs at the straggler's
+      speed); needs ≥ 2 reporting ranks and ≥ 1 s on the slowest so
+      warm-up noise can't fire it;
+    - ``LIGHTGBM_TPU_WATCH_PUSH_STALE_S`` (default 30): a source whose
+      last push is at least this old = ``dead_rank`` — the process is
+      hung, partitioned, or gone; level-based, re-arms when pushes
+      resume (a ``/healthz`` scrape is also an evaluation tick, since
+      a dead rank by definition stops generating push evaluations);
+    - ``LIGHTGBM_TPU_WATCH_SHED_RATE`` (default 0.05, shared with the
+      per-process rule): fleet-wide windowed shed share of serve
+      submissions summed ACROSS sources at or above this =
+      ``fleet_shed_rate`` — the fleet as a whole is overloaded even
+      if no single replica's local rate trips its own rule.
+    """
+    skew_thr = _env_float("LIGHTGBM_TPU_WATCH_RANK_SKEW", 2.0)
+    shed_thr = _env_float("LIGHTGBM_TPU_WATCH_SHED_RATE", 0.05)
+    # below this much stage time on the SLOWEST rank, ratios are
+    # warm-up noise, not skew
+    kMinStageSeconds = 1.0
+    kMinSheds = 8.0
+
+    def _ranks(snap):
+        return (snap.get("fleet") or {}).get("ranks") or {}
+
+    def rank_skew(snap, state):
+        # per RANK, not per source: a rank's train + serve processes
+        # both push, and stage seconds belong to the rank they ran on
+        per_rank: Dict[str, float] = {}
+        for e in _ranks(snap).values():
+            r = str(e.get("rank", "?"))
+            per_rank[r] = per_rank.get(r, 0.0) \
+                + float(e.get("stage_seconds", 0.0))
+        per_rank = {r: s for r, s in per_rank.items() if s > 0.0}
+        if len(per_rank) < 2:
+            return None
+        slow_r = max(per_rank, key=per_rank.get)
+        fast_r = min(per_rank, key=per_rank.get)
+        slowest, fastest = per_rank[slow_r], per_rank[fast_r]
+        if slowest < kMinStageSeconds:
+            return None
+        ratio = slowest / max(fastest, 1e-9)
+        if ratio >= skew_thr:
+            return {"value": round(ratio, 3), "threshold": skew_thr,
+                    "detail": "rank %s spent %.1fx the stage seconds "
+                              "of rank %s (%.2fs vs %.2fs) — the "
+                              "collective loop runs at the "
+                              "straggler's speed"
+                              % (slow_r, ratio, fast_r,
+                                 slowest, fastest)}
+        return None
+
+    def dead_rank(snap, state):
+        fleet = snap.get("fleet") or {}
+        stale_after = float(fleet.get("stale_after_s", 30.0))
+        stale = {k: float(e.get("age_s", 0.0))
+                 for k, e in _ranks(snap).items()
+                 if float(e.get("age_s", 0.0)) >= stale_after}
+        if stale:
+            worst = max(stale.values())
+            return {"value": round(worst, 3), "threshold": stale_after,
+                    "detail": "no push from %s for %.1fs (stale after "
+                              "%.0fs) — hung, partitioned, or dead"
+                              % (", ".join(sorted(stale)), worst,
+                                 stale_after)}
+        return None
+
+    def fleet_shed_rate(snap, state):
+        # windowed like the per-process shed_rate: first observation
+        # arms the baselines, then the fleet-summed deltas are the
+        # signal (cumulative counters grow forever on a healthy fleet
+        # that survived one spike)
+        shed = sum(float(e.get("shed_total", 0.0))
+                   for e in _ranks(snap).values())
+        reqs = sum(float(e.get("requests", 0.0))
+                   for e in _ranks(snap).values())
+        if "prev_shed" not in state:
+            state["prev_shed"], state["prev_req"] = shed, reqs
+            return None
+        d_shed = shed - state["prev_shed"]
+        d_req = reqs - state["prev_req"]
+        state["prev_shed"], state["prev_req"] = shed, reqs
+        if d_shed < kMinSheds:
+            return None
+        share = d_shed / max(d_req, d_shed, 1.0)
+        if share >= shed_thr:
+            return {"value": round(share, 4), "threshold": shed_thr,
+                    "detail": "the fleet shed %d of %d serve "
+                              "submissions in one push window "
+                              "(fleet-wide overload)"
+                              % (d_shed, d_req)}
+        return None
+
+    return [WatchRule("rank_skew", rank_skew),
+            WatchRule("dead_rank", dead_rank),
+            WatchRule("fleet_shed_rate", fleet_shed_rate)]
+
+
 class Watchdog:
     """Evaluate threshold rules over successive registry snapshots,
     emitting one ``health`` event per breach (false→true transition;

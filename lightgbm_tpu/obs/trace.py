@@ -47,6 +47,16 @@ growing RSS. ``tools/trace_report.py`` validates / merges / summarizes
 / tails segment directories. Flush (atexit, ``log.fatal``,
 :func:`configure`) finalizes the partial tail segment, so the on-disk
 directory never holds invalid JSON.
+
+``LIGHTGBM_TPU_TRACE_FORMAT=compact`` switches the streaming spool to
+the string-interned varint binary segment format of
+:mod:`obs.trace_compact` (``segment-r<rank>-<seq>.ctrace``, ≥3x
+smaller on disk, same atomic finalize + rotation + drop accounting);
+``tools/trace_report.py`` reads both transparently and ``convert``
+turns compact segments back into lossless Chrome-trace JSON. Every
+segment's ``otherData`` carries the run-correlation id
+(``obs.events.run_id`` / ``LIGHTGBM_TPU_RUN_ID``) so fleet reports can
+join segments with gateway metrics.
 """
 from __future__ import annotations
 
@@ -62,12 +72,14 @@ from typing import Dict, List, Optional
 
 from . import events as _events
 from . import faults
+from . import trace_compact as _compact
 from .registry import install_trace_hooks as _install_trace_hooks
 from .registry import registry
 
 _ENV_VAR = "LIGHTGBM_TPU_TRACE"
 _ENV_STREAM = "LIGHTGBM_TPU_TRACE_STREAM"
 _ENV_SEGMENT_BYTES = "LIGHTGBM_TPU_TRACE_SEGMENT_BYTES"
+_ENV_FORMAT = "LIGHTGBM_TPU_TRACE_FORMAT"
 
 kMaxEvents = 1 << 18
 kDefaultSegmentBytes = 8 << 20
@@ -218,7 +230,8 @@ def configure_stream(dirpath: Optional[str],
                      segment_bytes: Optional[int] = None,
                      stage_events: Optional[int] = None,
                      max_pending: Optional[int] = None,
-                     process_index_override: Optional[int] = None) -> None:
+                     process_index_override: Optional[int] = None,
+                     segment_format: Optional[str] = None) -> None:
     """Pin the streaming segment-directory sink programmatically
     (overrides ``LIGHTGBM_TPU_TRACE_STREAM``). ``None`` turns
     streaming OFF outright — unlike :func:`configure` it does NOT fall
@@ -229,7 +242,8 @@ def configure_stream(dirpath: Optional[str],
     sequence. ``segment_bytes`` / ``stage_events`` / ``max_pending``
     override the rotation size, the hot-path staging chunk, and the
     writer backlog cap (tests shrink all three to force rotation and
-    drops at toy scale)."""
+    drops at toy scale); ``segment_format`` (``"json"`` default /
+    ``"compact"``) overrides ``LIGHTGBM_TPU_TRACE_FORMAT``."""
     global _stream_override, _stream_disabled, _spool, _trace_id
     old = _spool
     # whichever sink is currently active gets its staged events first
@@ -247,7 +261,8 @@ def configure_stream(dirpath: Optional[str],
         if stream_dir() is not None:
             _spool = _Spool(stream_dir(), segment_bytes=segment_bytes,
                             stage_events=stage_events,
-                            max_pending=max_pending)
+                            max_pending=max_pending,
+                            segment_format=segment_format)
     if process_index_override is not None:
         set_process_index(process_index_override)
 
@@ -305,13 +320,15 @@ class _Spool:
     dropped whole and counted under ``trace/dropped_events``, so RSS
     stays bounded no matter how long the run is.
 
-    Writer thread: serializes each event once (json line) and, when the
-    serialized size of the open segment reaches ``segment_bytes``,
-    finalizes it ATOMICALLY — the full Chrome-trace document (lane
-    metadata + events + otherData) is written to ``<name>.tmp`` and
-    ``os.replace``d to ``segment-r<rank>-<seq>.json``. Every file in
-    the directory is therefore always complete, valid JSON; readers
-    (``trace_report.py tail``) never see a partial segment.
+    Writer thread: serializes each event once (a json line, or — in
+    ``compact`` format — interned varint records via
+    obs/trace_compact.py) and, when the serialized size of the open
+    segment reaches ``segment_bytes``, finalizes it ATOMICALLY — the
+    full document (lane metadata + events + otherData) is written to
+    ``<name>.tmp`` and ``os.replace``d to
+    ``segment-r<rank>-<seq>.json`` / ``.ctrace``. Every file in the
+    directory is therefore always a complete, valid segment; readers
+    (``trace_report.py tail``) never see a partial one.
 
     :meth:`flush` (atexit, ``log.fatal``, configure) drains staging +
     backlog and finalizes the partial tail segment. Never raises."""
@@ -319,7 +336,8 @@ class _Spool:
     def __init__(self, dirpath: str,
                  segment_bytes: Optional[int] = None,
                  stage_events: Optional[int] = None,
-                 max_pending: Optional[int] = None) -> None:
+                 max_pending: Optional[int] = None,
+                 segment_format: Optional[str] = None) -> None:
         self.dir = dirpath
         if segment_bytes is None:
             try:
@@ -327,6 +345,16 @@ class _Spool:
                     _ENV_SEGMENT_BYTES, kDefaultSegmentBytes))
             except ValueError:
                 segment_bytes = kDefaultSegmentBytes
+        if segment_format is None:
+            segment_format = os.environ.get(_ENV_FORMAT) or "json"
+        segment_format = segment_format.strip().lower()
+        if segment_format not in ("json", "compact"):
+            from ..utils import log
+            log.warning_always(
+                "unknown %s %r (json|compact) — using json"
+                % (_ENV_FORMAT, segment_format))
+            segment_format = "json"
+        self.format = segment_format
         self.segment_bytes = max(int(segment_bytes), 1)
         self.stage_events = max(int(stage_events or kStreamStageEvents), 1)
         self.max_pending = max(int(max_pending or kStreamMaxPending), 1)
@@ -337,6 +365,7 @@ class _Spool:
         self._io = threading.Lock()
         self._lines: List[str] = []
         self._bytes = 0
+        self._enc: Optional[_compact.SegmentEncoder] = None
         self._seq = 0
         self._seq_resumed = False
         self.events_emitted = 0
@@ -389,6 +418,17 @@ class _Spool:
 
     def _write_chunk(self, chunk: List[dict]) -> None:
         with self._io:
+            if self.format == "compact":
+                # incremental binary encode: the open segment's memory
+                # cost is its (already final) encoded bytes, same bound
+                # as the JSON line list
+                if self._enc is None:
+                    self._enc = _compact.SegmentEncoder()
+                for ev in chunk:
+                    self._enc.add_event(ev)
+                if self._enc.encoded_size >= self.segment_bytes:
+                    self._finalize_io_locked()
+                return
             for ev in chunk:
                 line = json.dumps(ev)
                 self._lines.append(line)
@@ -401,7 +441,10 @@ class _Spool:
         Caller holds ``_io``; takes the module ``_lock`` only for the
         lane-name snapshot (never the reverse order — push under
         ``_lock`` touches only staging/backlog)."""
-        if not self._lines:
+        compact = self.format == "compact"
+        n_payload = (self._enc.n_events if compact and self._enc
+                     else len(self._lines))
+        if not n_payload:
             return
         pid = process_index()
         if not self._seq_resumed:
@@ -409,37 +452,59 @@ class _Spool:
             # this rank (a restarted run, or a re-configured spool):
             # on-disk segments are evidence and must never be
             # overwritten. Deferred to first finalize — the rank may
-            # be pinned (dtrain) after the spool is constructed.
+            # be pinned (dtrain) after the spool is constructed. Both
+            # extensions count: a run restarted with the other format
+            # must not reuse a live sequence number.
             self._seq_resumed = True
             prefix = "segment-r%d-" % pid
             try:
                 for f in os.listdir(self.dir):
-                    if f.startswith(prefix) and f.endswith(".json"):
-                        try:
-                            seq = int(f[len(prefix):-len(".json")])
-                        except ValueError:
-                            continue
-                        self._seq = max(self._seq, seq + 1)
+                    if not f.startswith(prefix):
+                        continue
+                    stem = f[len(prefix):]
+                    for ext in (".json", _compact.EXTENSION):
+                        if stem.endswith(ext):
+                            try:
+                                seq = int(stem[:-len(ext)])
+                            except ValueError:
+                                break
+                            self._seq = max(self._seq, seq + 1)
+                            break
             except OSError:
                 pass
         with _lock:
             lanes = dict(_lane_names)
-        meta = [json.dumps(m) for m in _metadata_events(lanes, pid)]
+        meta_events = _metadata_events(lanes, pid)
         other = {"trace_id": trace_id(), "host": socket.gethostname(),
                  "os_pid": os.getpid(), "process_index": pid,
-                 "segment_index": self._seq, "events": len(self._lines),
+                 "run_id": _events.run_id(),
+                 "segment_index": self._seq, "events": n_payload,
                  "dropped_events": self.dropped,
                  "producer": "lightgbm_tpu/obs/trace.py"}
-        name = "segment-r%d-%05d.json" % (pid, self._seq)
+        if compact:
+            other["format"] = "compact"
+            name = "segment-r%d-%05d%s" % (pid, self._seq,
+                                           _compact.EXTENSION)
+            # lane metadata is only known at finalize; it appends after
+            # the payload records (read_segment restores meta-first
+            # ordering on decode)
+            for m in meta_events:
+                self._enc.add_event(m)
+            body = self._enc.segment_bytes(other)
+            mode = "wb"
+        else:
+            meta = [json.dumps(m) for m in meta_events]
+            name = "segment-r%d-%05d.json" % (pid, self._seq)
+            body = ('{"traceEvents":[' + ",".join(meta + self._lines)
+                    + '],"displayTimeUnit":"ms","otherData":'
+                    + json.dumps(other) + "}")
+            mode = "w"
         path = os.path.join(self.dir, name)
-        body = ('{"traceEvents":[' + ",".join(meta + self._lines)
-                + '],"displayTimeUnit":"ms","otherData":'
-                + json.dumps(other) + "}")
 
         def _write():
             faults.check("trace_finalize", segment=name)
             tmp = path + ".tmp"
-            with open(tmp, "w") as f:
+            with open(tmp, mode) as f:
                 f.write(body)
             os.replace(tmp, path)
 
@@ -450,15 +515,16 @@ class _Spool:
         try:
             retry_call(_write, site="trace_finalize")
         except Exception:
-            n_drop = len(self._lines)
-            self.dropped += n_drop
-            registry.inc("trace/dropped_events", n_drop)
+            self.dropped += n_payload
+            registry.inc("trace/dropped_events", n_payload)
             self._lines = []
             self._bytes = 0
+            self._enc = None
             return
         self._seq += 1
         self._lines = []
         self._bytes = 0
+        self._enc = None
         registry.inc("trace/segments_written")
 
     # -- flush ----------------------------------------------------------
@@ -588,7 +654,9 @@ def _note_event(rec: dict) -> None:
         return
     args = _base_args()
     for k, v in rec.items():
-        if k not in ("ts", "event"):
+        # run_id is per-run constant — it lives once in the segment's
+        # otherData, not on every instant event
+        if k not in ("ts", "event", "run_id"):
             args[k] = v
     stack = getattr(_tls, "stack", None)
     if stack:
@@ -759,6 +827,7 @@ def flush() -> None:
                              "host": socket.gethostname(),
                              "os_pid": os.getpid(),
                              "process_index": pid,
+                             "run_id": _events.run_id(),
                              "dropped_events": dropped,
                              "producer": "lightgbm_tpu/obs/trace.py"}}
         tmp = path + ".tmp"

@@ -137,14 +137,19 @@ def train(params: Dict, local_X: np.ndarray, local_y: np.ndarray,
     (``local_group`` per process), like the reference's pre-partitioned
     distributed data (config.h pre_partition)."""
     config = Config.from_params(params)
+    # rank pinned for the whole telemetry plane, not just tracing: the
+    # gateway pusher (obs/gateway.py) labels this process's pushes
+    # {rank=}, and process_index() would otherwise lazily resolve to 0
+    # if jax.distributed wasn't initialized when first asked
+    rank = int(jax.process_index())
+    obs_trace.set_process_index(rank)
     if obs_trace.active():
-        rank = int(jax.process_index())
         if obs_trace.stream_dir() is not None:
             # streaming mode: segments already carry the rank in the
-            # file name (segment-r<rank>-<seq>.json), so every rank can
-            # share one LIGHTGBM_TPU_TRACE_STREAM directory — only the
-            # pid needs pinning before the first event lands
-            obs_trace.set_process_index(rank)
+            # file name (segment-r<rank>-<seq>.json/.ctrace), so every
+            # rank can share one LIGHTGBM_TPU_TRACE_STREAM directory —
+            # the pid pin above landed before the first event
+            pass
         else:
             # one trace file per rank, pid = the rank: ranks share one
             # LIGHTGBM_TPU_TRACE value, the rank is folded into the
@@ -158,6 +163,12 @@ def train(params: Dict, local_X: np.ndarray, local_y: np.ndarray,
                 obs_trace.rank_path(obs_trace.sink_path(), rank),
                 process_index_override=rank, keep_buffer=True)
     obs_health.record_backend_once(source="dtrain")
+    # start the env-configured metrics exporter / fleet gateway pusher
+    # NOW (not at the first iteration's sample_iteration tick): the
+    # gateway should see every rank before the first — possibly long —
+    # distributed binning stage finishes, so dead_rank watches cover
+    # startup too
+    obs_trace.sample_iteration(0)
     local_X = np.asarray(local_X, dtype=np.float64)
     local_y = np.asarray(local_y, dtype=np.float64)
     n_local = local_X.shape[0]

@@ -513,6 +513,7 @@ class PredictServer:
                  autostart: bool = True,
                  metrics_port: Optional[int] = None,
                  metrics_host: str = "127.0.0.1",
+                 metrics_gateway: Optional[str] = None,
                  max_queue_rows: Optional[int] = None,
                  overflow: str = "reject",
                  block_timeout_ms: float = 1000.0,
@@ -602,6 +603,17 @@ class PredictServer:
                                              readiness=lambda:
                                              self.readiness)
             log.info("serve: /metrics listening on %s" % self.metrics.url)
+        # push-based fleet telemetry: metrics_gateway != None starts a
+        # SnapshotPusher POSTing this process's registry to an
+        # obs/gateway.py MetricsGateway, so a serving fleet appears in
+        # the same aggregated {rank=,process=} scrape as its trainer
+        # ranks. Falls back to LIGHTGBM_TPU_METRICS_GATEWAY via
+        # export.tick() like everything else env-driven.
+        self.pusher = None
+        if metrics_gateway is not None:
+            from ..obs.gateway import SnapshotPusher
+            self.pusher = SnapshotPusher(metrics_gateway,
+                                         role="serve").start()
         if autostart:
             self.start()
 
@@ -693,6 +705,11 @@ class PredictServer:
                             unresolved=len(stranded),
                             drain_timeout_s=float(drain_timeout_s))
             obs_events.flush()
+        if self.pusher is not None:
+            # one final push so the gateway sees the drained terminal
+            # counters, then stop the loop
+            self.pusher.push_now()
+            self.pusher.stop()
         if self.metrics is not None:
             self.metrics.close()
 

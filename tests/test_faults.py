@@ -337,6 +337,91 @@ class TestTelemetryFaults:
         assert reg.load(booster=b) == v1 + 1  # next swap succeeds
 
 
+class TestGatewayPushFaults:
+    """The ``gateway_push`` site (obs/gateway.py SnapshotPusher): a
+    transient fault is RETRIED to a delivered push; a dead gateway is a
+    SKIP with a counter — bounded wall time, training never stalls on
+    telemetry."""
+
+    def test_site_is_registered(self):
+        assert "gateway_push" in faults.SITES
+
+    def test_transient_push_fault_retried_to_success(self, monkeypatch):
+        monkeypatch.setenv("LIGHTGBM_TPU_RETRY_ATTEMPTS", "3")
+        from lightgbm_tpu.obs.gateway import MetricsGateway, \
+            SnapshotPusher
+        from lightgbm_tpu.obs.registry import MetricsRegistry
+        gw_reg = MetricsRegistry()
+        gw = MetricsGateway(reg=gw_reg)
+        reg = MetricsRegistry()
+        reg.enable()
+        reg.inc("push_probe/widgets", 2)
+        try:
+            faults.configure("gateway_push:nth:1")
+            p = SnapshotPusher(gw.url, interval=0, reg=reg, rank=5)
+            assert p.push_now() is True
+            assert reg.count("ft/retries/gateway_push") == 1
+            assert reg.count("ft/gateway_push_failed") == 0
+            assert gw_reg.count("gateway/pushes") == 1  # push LANDED
+        finally:
+            faults.reset()
+            gw.close()
+
+    def test_dead_gateway_degrades_bounded_and_recovers(
+            self, monkeypatch):
+        monkeypatch.setenv("LIGHTGBM_TPU_RETRY_ATTEMPTS", "2")
+        import socket
+        from lightgbm_tpu.obs.gateway import MetricsGateway, \
+            SnapshotPusher
+        from lightgbm_tpu.obs.registry import MetricsRegistry
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            dead_port = s.getsockname()[1]
+        reg = MetricsRegistry()
+        reg.enable()
+        reg.inc("x")
+        p = SnapshotPusher("http://127.0.0.1:%d" % dead_port,
+                           interval=0, reg=reg, rank=0, timeout_s=1.0)
+        t0 = time.time()
+        assert p.push_now() is False        # contract: never raises
+        wall = time.time() - t0
+        # bounded: attempts x (connect-refused + 1ms backoff) + slack
+        assert wall < 10.0, "push to a dead gateway stalled %.1fs" % wall
+        assert reg.count("ft/gateway_push_failed") == 1
+        # the SAME pusher recovers once a gateway exists at some url
+        gw = MetricsGateway(reg=MetricsRegistry())
+        try:
+            p.url = gw.url
+            assert p.push_now() is True
+            assert reg.count("gateway/pushes_sent") == 1
+        finally:
+            gw.close()
+
+    def test_persistent_fault_skips_push_never_raises(self, monkeypatch):
+        monkeypatch.setenv("LIGHTGBM_TPU_RETRY_ATTEMPTS", "2")
+        from lightgbm_tpu.obs.gateway import MetricsGateway, \
+            SnapshotPusher
+        from lightgbm_tpu.obs.registry import MetricsRegistry
+        gw_reg = MetricsRegistry()
+        gw = MetricsGateway(reg=gw_reg)
+        reg = MetricsRegistry()
+        reg.enable()
+        reg.inc("x")
+        try:
+            faults.configure("gateway_push:always")
+            p = SnapshotPusher(gw.url, interval=0, reg=reg, rank=0)
+            assert p.push_now() is False
+            assert p.push_now() is False
+            assert reg.count("ft/gateway_push_failed") == 2
+            assert reg.count("ft/retry_exhausted") == 2
+            assert gw_reg.count("gateway/pushes") == 0
+            faults.reset()
+            assert p.push_now() is True     # next tick recovers
+        finally:
+            faults.reset()
+            gw.close()
+
+
 class TestCheckpointFaults:
     def test_finalize_fault_retried_to_success(self, tmp_path):
         X, y = _data(400)

@@ -1,0 +1,452 @@
+"""Fleet metrics gateway (obs/gateway.py): push aggregation into one
+scrape target, strict-parse rejection, per-source staleness, the fleet
+watchdog rules (rank_skew / dead_rank / fleet_shed_rate firing exactly
+once per breach and re-arming), run-id correlation, the env-driven
+pusher wiring through export.tick(), the run-correlated fleet report
+(tools/trace_report.py fleet + tpu_phase_timer --from-metrics), and the
+real thing: subprocess ranks pushing from forced-multi-device training
+runs into one aggregated ``{rank=,process=}`` document."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.obs import events, export, faults, trace
+from lightgbm_tpu.obs.gateway import MetricsGateway, SnapshotPusher
+from lightgbm_tpu.obs.health import Watchdog, fleet_rules
+from lightgbm_tpu.obs.openmetrics import (metric_value, parse_openmetrics,
+                                          parse_type_headers, sum_metric)
+from lightgbm_tpu.obs.registry import MetricsRegistry, registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "trace_report_gw", os.path.join(REPO, "tools", "trace_report.py"))
+trace_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(trace_report)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    yield
+    faults.reset()
+    export.reset_exporter()
+    events.register_event_callback(None)
+    registry.disable()
+
+
+def _gateway(**kw):
+    reg = MetricsRegistry()
+    kw.setdefault("reg", reg)
+    kw.setdefault("watchdog", Watchdog(reg, rules=fleet_rules()))
+    return MetricsGateway(**kw)
+
+
+def _body(lines):
+    return "\n".join(lines + ["# EOF"]) + "\n"
+
+
+def _stage_body(seconds, stage="tree::grow"):
+    return _body([
+        "# TYPE lightgbm_tpu_stage_seconds_total counter",
+        'lightgbm_tpu_stage_seconds_total{stage="%s"} %s'
+        % (stage, seconds)])
+
+
+def _health_events(seen, rule):
+    return [r for r in seen
+            if r["event"] == "health" and r.get("rule") == rule]
+
+
+# ----------------------------------------------------------------------
+# aggregation: many pushes, one scrape target
+# ----------------------------------------------------------------------
+
+class TestAggregation:
+    def test_pushes_aggregate_with_rank_process_labels(self):
+        gw = _gateway()
+        try:
+            assert gw.accept_push(_stage_body(10.0), rank="0",
+                                  process="train:11",
+                                  run_id="r1")[0] == 200
+            assert gw.accept_push(_stage_body(4.0), rank="1",
+                                  process="train:22",
+                                  run_id="r1")[0] == 200
+            with urllib.request.urlopen(gw.url + "/metrics",
+                                        timeout=10) as resp:
+                text = resp.read().decode()
+            parsed = parse_openmetrics(text)
+            assert metric_value(
+                parsed, "lightgbm_tpu_stage_seconds_total",
+                rank="0", process="train:11", stage="tree::grow") == 10.0
+            assert metric_value(
+                parsed, "lightgbm_tpu_stage_seconds_total",
+                rank="1", process="train:22", stage="tree::grow") == 4.0
+            # ONE contiguous family under one # TYPE header
+            assert text.count(
+                "# TYPE lightgbm_tpu_stage_seconds_total counter") == 1
+            assert parse_type_headers(text)[
+                "lightgbm_tpu_stage_seconds_total"] == "counter"
+            # gateway-own families: freshness, push counts, run ids
+            assert metric_value(parsed,
+                                "lightgbm_tpu_gateway_push_age_seconds",
+                                rank="0", process="train:11") < 10.0
+            assert metric_value(parsed, "lightgbm_tpu_gateway_sources") \
+                == 2.0
+            assert metric_value(parsed, "lightgbm_tpu_run_info",
+                                run_id="r1") == 1.0
+        finally:
+            gw.close()
+
+    def test_repush_is_last_value_wins_per_source(self):
+        gw = _gateway()
+        try:
+            gw.accept_push(_stage_body(1.0), rank="0", process="p")
+            gw.accept_push(_stage_body(5.0), rank="0", process="p")
+            parsed = parse_openmetrics(gw.render())
+            assert sum_metric(parsed, "lightgbm_tpu_stage_seconds_total",
+                              rank="0") == 5.0
+            assert metric_value(parsed,
+                                "lightgbm_tpu_gateway_pushes_total",
+                                rank="0", process="p") == 2.0
+        finally:
+            gw.close()
+
+    def test_pushed_rank_labels_are_superseded(self):
+        # a snapshot that already carries rank= labels (e.g. relayed)
+        # must not produce duplicate label keys in the aggregate
+        gw = _gateway()
+        try:
+            gw.accept_push(_body([
+                'lightgbm_tpu_widgets_total{rank="9",stage="x"} 3']),
+                rank="0", process="p")
+            parsed = parse_openmetrics(gw.render())
+            assert metric_value(parsed, "lightgbm_tpu_widgets_total",
+                                rank="0", process="p", stage="x") == 3.0
+        finally:
+            gw.close()
+
+    def test_malformed_push_is_400_not_poison(self):
+        gw = _gateway()
+        try:
+            status, msg = gw.accept_push("not { openmetrics 1.0 oops",
+                                         rank="0", process="p")
+            assert status == 400 and "malformed" in msg
+            assert gw.reg.count("gateway/rejected") == 1
+            # the scrape stays valid (and empty of the bad push)
+            parsed = parse_openmetrics(gw.render())
+            assert sum_metric(parsed, "lightgbm_tpu_widgets_total") == 0.0
+            # over HTTP the same body is a 400 response
+            req = urllib.request.Request(
+                gw.url + "/push?rank=0&process=p",
+                data=b"not { openmetrics", method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400
+        finally:
+            gw.close()
+
+
+# ----------------------------------------------------------------------
+# the push side: SnapshotPusher end to end
+# ----------------------------------------------------------------------
+
+class TestPusher:
+    def test_push_now_end_to_end(self):
+        gw = _gateway()
+        reg = MetricsRegistry()
+        reg.enable()
+        reg.inc("gw_probe/widgets", 7)
+        try:
+            p = SnapshotPusher(gw.url, interval=0, reg=reg, rank=3,
+                               role="test")
+            assert p.push_now() is True
+            assert reg.count("gateway/pushes_sent") == 1
+            parsed = parse_openmetrics(gw.render())
+            assert metric_value(parsed,
+                                "lightgbm_tpu_gw_probe_widgets_total",
+                                rank="3", process=p.process) == 7.0
+            hz = gw.healthz()
+            assert hz["num_sources"] == 1 and not hz["stale"]
+        finally:
+            gw.close()
+
+    def test_env_tick_starts_pusher_once(self, monkeypatch):
+        gw = _gateway()
+        reg = MetricsRegistry()
+        reg.enable()
+        reg.inc("gw_tick/widgets")
+        monkeypatch.setenv("LIGHTGBM_TPU_METRICS_GATEWAY", gw.url)
+        monkeypatch.setenv("LIGHTGBM_TPU_METRICS_PUSH_INTERVAL", "0.05")
+        try:
+            export.reset_exporter()
+            export.tick(reg)
+            pusher = export._pusher
+            assert pusher is not None
+            export.tick(reg)
+            assert export._pusher is pusher  # singleton
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                parsed = parse_openmetrics(gw.render())
+                if sum_metric(parsed,
+                              "lightgbm_tpu_gw_tick_widgets_total") > 0:
+                    break
+                time.sleep(0.02)
+            assert sum_metric(parsed,
+                              "lightgbm_tpu_gw_tick_widgets_total") == 1.0
+        finally:
+            export.reset_exporter()
+            gw.close()
+
+    def test_run_id_stamped_and_served(self, monkeypatch):
+        monkeypatch.setenv("LIGHTGBM_TPU_RUN_ID", "test-run-77")
+        gw = _gateway()
+        reg = MetricsRegistry()
+        reg.enable()
+        reg.inc("x")
+        try:
+            SnapshotPusher(gw.url, interval=0, reg=reg, rank=0).push_now()
+            parsed = parse_openmetrics(gw.render())
+            assert metric_value(parsed, "lightgbm_tpu_run_info",
+                                run_id="test-run-77") == 1.0
+            assert gw.healthz()["run_ids"] == ["test-run-77"]
+        finally:
+            gw.close()
+
+
+# ----------------------------------------------------------------------
+# fleet watchdog rules at the gateway
+# ----------------------------------------------------------------------
+
+class TestFleetWatchdog:
+    def test_dead_rank_fires_once_and_rearms(self):
+        seen = []
+        events.register_event_callback(lambda r: seen.append(r))
+        gw = _gateway(stale_after_s=0.05)
+        try:
+            gw.accept_push(_stage_body(1.0), rank="0", process="p")
+            assert _health_events(seen, "dead_rank") == []
+            time.sleep(0.1)
+            hz = gw.healthz()
+            assert hz["stale"] == ["0/p"]
+            assert len(_health_events(seen, "dead_rank")) == 1
+            assert [b["rule"] for b in hz["breached"]] == ["dead_rank"]
+            gw.healthz()  # still stale: NO second event
+            assert len(_health_events(seen, "dead_rank")) == 1
+            # a fresh push clears the breach and re-arms the rule
+            gw.accept_push(_stage_body(1.0), rank="0", process="p")
+            assert gw.healthz()["stale"] == []
+            time.sleep(0.1)
+            gw.healthz()
+            assert len(_health_events(seen, "dead_rank")) == 2
+        finally:
+            gw.close()
+
+    def test_rank_skew_fires_once_per_breach(self):
+        seen = []
+        events.register_event_callback(lambda r: seen.append(r))
+        gw = _gateway()
+        try:
+            gw.accept_push(_stage_body(10.0), rank="0", process="a")
+            gw.accept_push(_stage_body(9.0), rank="1", process="b")
+            assert _health_events(seen, "rank_skew") == []  # ratio 1.1
+            gw.accept_push(_stage_body(1.0), rank="1", process="b")
+            assert len(_health_events(seen, "rank_skew")) == 1
+            ev = _health_events(seen, "rank_skew")[0]
+            assert ev["value"] == 10.0 and "rank 0" in ev["detail"]
+            gw.accept_push(_stage_body(10.5), rank="0", process="a")
+            assert len(_health_events(seen, "rank_skew")) == 1  # no refire
+            # skew clears (rank 1 catches up), then re-breaches
+            gw.accept_push(_stage_body(9.0), rank="1", process="b")
+            gw.accept_push(_stage_body(1.0), rank="1", process="b")
+            assert len(_health_events(seen, "rank_skew")) == 2
+        finally:
+            gw.close()
+
+    def test_rank_skew_sums_processes_of_one_rank(self):
+        # train + serve processes of the SAME rank must not read as
+        # two skewed ranks
+        seen = []
+        events.register_event_callback(lambda r: seen.append(r))
+        gw = _gateway()
+        try:
+            gw.accept_push(_stage_body(5.0), rank="0", process="train")
+            gw.accept_push(_stage_body(5.0), rank="0", process="serve")
+            assert _health_events(seen, "rank_skew") == []
+        finally:
+            gw.close()
+
+    def test_fleet_shed_rate_is_windowed(self):
+        seen = []
+        events.register_event_callback(lambda r: seen.append(r))
+        gw = _gateway()
+
+        def shed_body(shed, reqs):
+            return _body([
+                "# TYPE lightgbm_tpu_serve_shed_total counter",
+                "lightgbm_tpu_serve_shed_total %d" % shed,
+                "# TYPE lightgbm_tpu_serve_requests_total counter",
+                "lightgbm_tpu_serve_requests_total %d" % reqs])
+
+        try:
+            # first observation arms the baseline — history, no breach
+            gw.accept_push(shed_body(500, 1000), rank="0", process="s")
+            assert _health_events(seen, "fleet_shed_rate") == []
+            # window delta: 50 shed of 100 new submissions = 50%
+            gw.accept_push(shed_body(550, 1100), rank="0", process="s")
+            assert len(_health_events(seen, "fleet_shed_rate")) == 1
+        finally:
+            gw.close()
+
+
+# ----------------------------------------------------------------------
+# run-correlated fleet reporting (tools)
+# ----------------------------------------------------------------------
+
+class TestFleetReport:
+    def _seed_trace(self, tmp_path, run_id):
+        d = str(tmp_path / "segs")
+        os.environ["LIGHTGBM_TPU_RUN_ID"] = run_id
+        try:
+            registry.reset()
+            trace.configure_stream(d)
+            with registry.scope("tree::grow"):
+                pass
+            trace.flush()
+        finally:
+            trace.configure_stream(None)
+            os.environ.pop("LIGHTGBM_TPU_RUN_ID", None)
+        return d
+
+    def test_fleet_report_joins_trace_and_metrics(self, tmp_path):
+        d = self._seed_trace(tmp_path, "join-run")
+        gw = _gateway()
+        try:
+            os.environ["LIGHTGBM_TPU_RUN_ID"] = "join-run"
+            gw.accept_push(_stage_body(10.0), rank="0", process="t",
+                           run_id="join-run")
+            gw.accept_push(_stage_body(4.0), rank="1", process="t",
+                           run_id="join-run")
+            report = trace_report.fleet_report(
+                d, trace_report.fetch_metrics_text(gw.url))
+        finally:
+            os.environ.pop("LIGHTGBM_TPU_RUN_ID", None)
+            gw.close()
+        assert report["run_id_match"] is True
+        assert report["trace"]["run_ids"] == ["join-run"]
+        assert report["rank_skew"]["ratio"] == 2.5
+        assert report["ranks"]["0"]["metrics_stage_seconds"][
+            "tree::grow"] == 10.0
+        assert "tree::grow" in report["ranks"]["0"]["trace_stage_seconds"]
+        assert report["ranks"]["0"]["push_age_s"] is not None
+
+    def test_fleet_report_flags_run_mismatch(self, tmp_path):
+        d = self._seed_trace(tmp_path, "run-A")
+        gw = _gateway()
+        try:
+            gw.accept_push(_stage_body(1.0), rank="0", process="t",
+                           run_id="run-B")
+            report = trace_report.fleet_report(
+                d, trace_report.fetch_metrics_text(gw.url + "/metrics"))
+        finally:
+            gw.close()
+        assert report["run_id_match"] is False
+        assert report["run_ids_matched"] == []
+
+    def test_phase_timer_from_metrics_dump(self, tmp_path):
+        gw = _gateway()
+        try:
+            gw.accept_push(_stage_body(10.0), rank="0", process="t",
+                           run_id="pt-run")
+            dump = str(tmp_path / "metrics.txt")
+            with open(dump, "w") as f:
+                f.write(gw.render())
+        finally:
+            gw.close()
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "tpu_phase_timer.py"),
+             "--from-metrics", dump],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        lines = [json.loads(x) for x in out.stdout.splitlines()]
+        ranks = {r["rank"]: r["phases"] for r in lines if "rank" in r}
+        assert ranks["0"]["tree::grow"]["s"] == 10.0
+        fleet = [r for r in lines if r.get("phase") == "fleet"][0]
+        assert fleet["ranks"] == 1 and fleet["run_ids"] == ["pt-run"]
+
+
+# ----------------------------------------------------------------------
+# the real thing: subprocess ranks under forced device counts
+# ----------------------------------------------------------------------
+
+_RANK_CHILD = r"""
+import sys
+import numpy as np, jax
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import trace
+rank = int(sys.argv[1])
+assert len(jax.devices()) == 2, jax.devices()
+trace.set_process_index(rank)    # what parallel/dtrain.py pins per rank
+rng = np.random.RandomState(rank)
+X = rng.randn(400, 6)
+y = (X[:, 0] + 0.3 * rng.randn(400) > 0).astype(float)
+lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1,
+           "min_data_in_leaf": 5, "max_bin": 63},
+          lgb.Dataset(X, label=y), num_boost_round=2)
+print("RANK_PUSH_OK")
+"""
+
+
+def test_multi_rank_subprocess_pushes_aggregate():
+    """Two training subprocesses (forced 2-device CPU backends), each
+    auto-wired to the parent's gateway purely through env vars
+    (LIGHTGBM_TPU_METRICS_GATEWAY picked up by export.tick inside the
+    training loop, LIGHTGBM_TPU_RUN_ID inherited) — the parent's ONE
+    scrape serves both ranks' stage tables."""
+    gw = _gateway(stale_after_s=300)
+    try:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(
+            flags + ["--xla_force_host_platform_device_count=2"])
+        env["LIGHTGBM_TPU_METRICS_GATEWAY"] = gw.url
+        env["LIGHTGBM_TPU_METRICS_PUSH_INTERVAL"] = "0.2"
+        env["LIGHTGBM_TPU_RUN_ID"] = "fleet-e2e"
+        env["LIGHTGBM_TPU_TIMETAG"] = "1"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("LIGHTGBM_TPU_EVENT_LOG", None)
+        env.pop("LIGHTGBM_TPU_METRICS", None)
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _RANK_CHILD, str(r)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=REPO) for r in range(2)]
+        logs = [p.communicate(timeout=420)[0] for p in procs]
+        for r, (p, out) in enumerate(zip(procs, logs)):
+            assert p.returncode == 0 and "RANK_PUSH_OK" in out, (
+                "rank %d:\n%s" % (r, out[-3000:]))
+
+        with urllib.request.urlopen(gw.url + "/metrics",
+                                    timeout=10) as resp:
+            text = resp.read().decode()
+        parsed = parse_openmetrics(text)
+        for r in ("0", "1"):
+            assert sum_metric(parsed, "lightgbm_tpu_stage_seconds_total",
+                              rank=r, stage="tree::grow") > 0.0, \
+                "rank %s stage table missing from the aggregate" % r
+        assert metric_value(parsed, "lightgbm_tpu_run_info",
+                            run_id="fleet-e2e") == 1.0
+        hz = gw.healthz()
+        assert hz["num_sources"] == 2
+        assert hz["run_ids"] == ["fleet-e2e"]
+    finally:
+        gw.close()

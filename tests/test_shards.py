@@ -264,11 +264,20 @@ class TestShardPrefetcher:
         booster = create_boosting(
             Config.from_params(dict(params, num_iterations=4)), ds)
         registry.reset()
+
+        def _staged_after_drain():
+            # the counter ticks on the prefetch worker thread; barrier
+            # through the single-worker pool so an in-flight staging
+            # lands in ITS OWN iteration's bucket, not the next one
+            booster.learner.prefetcher._pool.submit(
+                lambda: None).result(timeout=60)
+            return registry.count("io/shards_staged")
+
         per_iter = []
         for _ in range(4):
-            before = registry.count("io/shards_staged")
+            before = _staged_after_drain()
             booster.train_one_iter()
-            per_iter.append(registry.count("io/shards_staged") - before)
+            per_iter.append(_staged_after_drain() - before)
             # a sweep is parked for the next iteration's root
             assert booster.learner._next_sweep is not None
         # iteration 1 pays the stashed sweep's staging at its own end;

@@ -1,0 +1,269 @@
+"""Compact binary trace segments (obs/trace_compact.py): lossless
+codec round-trips, truncation detection, the streaming spool's
+``LIGHTGBM_TPU_TRACE_FORMAT=compact`` path (rotation, atomic finalize,
+crash-mid-segment validity, run-id stamping), size shrink vs the JSON
+format, and trace_report's transparent loading / lossless ``convert``
+of compact and mixed-format directories."""
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from lightgbm_tpu.obs import events, trace, trace_compact
+from lightgbm_tpu.obs.registry import registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "trace_report_ct", os.path.join(REPO, "tools", "trace_report.py"))
+trace_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(trace_report)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    trace.configure_stream(None)
+    registry.disable()
+    registry.timer.sampling = False
+
+
+def _span(name, ts, sid, pid=0, **args):
+    return {"name": name, "ph": "X", "ts": ts, "dur": 42.5, "pid": pid,
+            "tid": 0, "cat": "stage",
+            "args": dict({"span_id": sid, "trace_id": "t-%d" % pid,
+                          "parent_span_id": 0}, **args)}
+
+
+# ----------------------------------------------------------------------
+# codec
+# ----------------------------------------------------------------------
+
+class TestCodec:
+    def test_roundtrip_exact_types_and_values(self):
+        events_in = [
+            {"name": "uniçode ☃", "ph": "X", "ts": 1.5,
+             "dur": 0.25, "pid": 0, "tid": 3,
+             "args": {"nested": {"list": [1, 2.0, "three", None, True],
+                                 "empty": {}, "neg": -(2 ** 40)},
+                      "flag": False}},
+            {"name": "ints", "ph": "i", "ts": 2, "pid": 0, "tid": 0,
+             "args": {"zero": 0, "big": 2 ** 52, "tiny": -1}},
+        ]
+        header = {"trace_id": "abc", "run_id": "r", "n_events": 2}
+        data = trace_compact.encode_events(events_in, header)
+        hdr, back = trace_compact.decode_segment(data)
+        assert hdr == header
+        assert back == events_in
+        # int-ness and float-ness survive exactly (1 == 1.0 in python,
+        # so == alone cannot prove this)
+        a = back[0]["args"]["nested"]["list"]
+        assert isinstance(a[0], int) and isinstance(a[1], float)
+        assert isinstance(back[1]["ts"], int)
+        assert isinstance(back[0]["ts"], float)
+        assert back[0]["args"]["flag"] is False
+
+    def test_strings_interned_once(self):
+        evs = [_span("stage::same", float(i), i) for i in range(200)]
+        data = trace_compact.encode_events(evs, {})
+        assert data.count(b"stage::same") == 1
+        _h, back = trace_compact.decode_segment(data)
+        assert back == [trace_compact._normalize(e) for e in evs]
+
+    def test_truncation_detected_at_any_cut(self):
+        evs = [_span("s%d" % i, float(i), i) for i in range(20)]
+        data = trace_compact.encode_events(evs, {"n": 20})
+        for cut in (4, len(data) // 3, len(data) // 2, len(data) - 1):
+            with pytest.raises(ValueError):
+                trace_compact.decode_segment(data[:cut])
+
+    def test_trailing_garbage_detected(self):
+        data = trace_compact.encode_events([_span("a", 1.0, 1)], {})
+        with pytest.raises(ValueError, match="trailing"):
+            trace_compact.decode_segment(data + b"\x00\x01")
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(ValueError, match="magic"):
+            trace_compact.decode_segment(b"NOTATRACE-------")
+
+    def test_shrink_at_least_3x_on_span_streams(self):
+        """The acceptance ratio, on the same event shape the spool
+        emits: repeated stage names + per-span float/int args."""
+        names = ["tree::grow", "tree::split_batches", "gbdt::gradients",
+                 "io::find_bin"]
+        evs = [_span(names[i % 4], 1e6 + i * 113.7, i, iter=i // 4)
+               for i in range(2000)]
+        as_json = ("\n".join(json.dumps(e) for e in evs)).encode()
+        compact = trace_compact.encode_events(evs, {})
+        shrink = len(as_json) / len(compact)
+        assert shrink >= 3.0, "only %.2fx" % shrink
+        _h, back = trace_compact.decode_segment(compact)  # and lossless
+        assert back == evs
+
+
+# ----------------------------------------------------------------------
+# the spool's compact mode
+# ----------------------------------------------------------------------
+
+class TestCompactSpool:
+    def test_rotation_validate_and_summary(self, tmp_path):
+        d = str(tmp_path / "segs")
+        registry.reset()
+        trace.configure_stream(d, segment_bytes=20_000,
+                               stage_events=128, segment_format="compact")
+        n = 4000
+        for _ in range(n):
+            with registry.scope("probe::compact"):
+                pass
+        trace.flush()
+        segs = trace_report.segment_files(d)
+        assert len(segs) >= 3, "no rotation"
+        assert all(s.endswith(".ctrace") for s in segs)
+        assert registry.count("trace/dropped_events") == 0
+        errors, stats = trace_report.validate_dir(d)
+        assert errors == []
+        assert stats["spans"] == n
+        table = trace_report.summarize(trace_report.load_trace(d))
+        assert table["phases"]["probe::compact"]["calls"] == n
+        # finalize is atomic: no tmp litter, headers self-describe
+        assert not [f for f in os.listdir(d) if f.endswith(".tmp")]
+        od = trace_report.load_file(segs[0])["otherData"]
+        assert od["format"] == "compact"
+        assert od["run_id"] == events.run_id()
+        assert od["events"] > 0
+
+    def test_env_format_selects_compact(self, tmp_path, monkeypatch):
+        d = str(tmp_path / "segs")
+        monkeypatch.setenv("LIGHTGBM_TPU_TRACE_FORMAT", "compact")
+        registry.reset()
+        trace.configure_stream(d)
+        with registry.scope("probe::env"):
+            pass
+        trace.flush()
+        segs = trace_report.segment_files(d)
+        assert len(segs) == 1 and segs[0].endswith(".ctrace")
+
+    def test_unknown_format_falls_back_to_json(self, tmp_path,
+                                               monkeypatch):
+        d = str(tmp_path / "segs")
+        monkeypatch.setenv("LIGHTGBM_TPU_TRACE_FORMAT", "protobuf")
+        registry.reset()
+        trace.configure_stream(d)
+        with registry.scope("probe::fallback"):
+            pass
+        trace.flush()
+        segs = trace_report.segment_files(d)
+        assert len(segs) == 1 and segs[0].endswith(".json")
+
+    def test_convert_roundtrip_matches_json_export(self, tmp_path):
+        """Span-for-span: a JSON segment re-encoded through the codec
+        and converted back is the identical document."""
+        d = str(tmp_path / "segs")
+        registry.reset()
+        trace.configure_stream(d, segment_format="json")
+        for _ in range(50):
+            with registry.scope("probe::rt"):
+                pass
+        trace.flush()
+        src = trace_report.segment_files(d)[0]
+        doc = trace_report.load_file(src)
+        ct = str(tmp_path / "reencoded.ctrace")
+        with open(ct, "wb") as f:
+            f.write(trace_compact.encode_events(
+                doc["traceEvents"], doc["otherData"]))
+        out = str(tmp_path / "back.json")
+        assert trace_report.main(["convert", "-o", out, ct]) == 0
+        back = json.load(open(out))
+        assert back["traceEvents"] == doc["traceEvents"]
+        assert back["otherData"] == doc["otherData"]
+
+    def test_convert_directory_and_validate(self, tmp_path):
+        d = str(tmp_path / "segs")
+        registry.reset()
+        trace.configure_stream(d, segment_bytes=20_000,
+                               segment_format="compact")
+        for _ in range(2000):
+            with registry.scope("probe::conv"):
+                pass
+        trace.flush()
+        out = str(tmp_path / "converted.json")
+        assert trace_report.main(["convert", "-o", out, d]) == 0
+        doc = json.load(open(out))
+        assert trace_report.validate_trace(doc, check_parents=False) == []
+        assert sum(1 for e in doc["traceEvents"]
+                   if e.get("ph") == "X") == 2000
+
+    def test_mixed_format_directory_merges_and_tails(self, tmp_path,
+                                                     capsys):
+        d = str(tmp_path / "segs")
+        registry.reset()
+        trace.configure_stream(d, segment_format="compact")
+        with registry.scope("probe::mixed"):
+            pass
+        trace.flush()
+        trace.configure_stream(d, segment_format="json")
+        with registry.scope("probe::mixed"):
+            pass
+        trace.flush()
+        trace.configure_stream(None)
+        segs = trace_report.segment_files(d)
+        assert {os.path.splitext(s)[1] for s in segs} \
+            == {".ctrace", ".json"}
+        errors, stats = trace_report.validate_dir(d)
+        assert errors == [] and stats["spans"] == 2
+        merged = trace_report.merge_traces([d])
+        assert trace_report.summarize(merged)["phases"][
+            "probe::mixed"]["calls"] == 2
+        assert trace_report.tail_dir(d) == 0
+        out = capsys.readouterr().out
+        assert out.count("1 spans") == 2
+
+
+_CRASH_CHILD = r"""
+import sys
+from lightgbm_tpu.obs import trace
+from lightgbm_tpu.obs.registry import registry
+trace.configure_stream(sys.argv[1], segment_bytes=8_000,
+                       stage_events=64, segment_format="compact")
+n = 0
+while True:
+    with registry.scope("probe::crash"):
+        pass
+    n += 1
+    if n == 4000:
+        print("READY", flush=True)
+"""
+
+
+def test_crash_mid_segment_leaves_only_valid_segments(tmp_path):
+    """SIGKILL mid-write: every FINALIZED ``.ctrace`` still decodes and
+    validates (atomic tmp+rename — a torn segment can only exist as a
+    ``.tmp`` the readers never pick up)."""
+    d = str(tmp_path / "segs")
+    os.makedirs(d)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen([sys.executable, "-c", _CRASH_CHILD, d],
+                            env=env, cwd=REPO, stdout=subprocess.PIPE,
+                            text=True)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        deadline = time.time() + 60
+        while len(trace_report.segment_files(d)) < 2 \
+                and time.time() < deadline:
+            time.sleep(0.05)
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    segs = trace_report.segment_files(d)
+    assert len(segs) >= 2, "child never rotated"
+    for s in segs:
+        doc = trace_report.load_file(s)  # raises on truncation
+        assert trace_report.validate_trace(doc, check_parents=False) \
+            == [], s
+        assert doc["otherData"]["format"] == "compact"

@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# One-shot observability gate (CI and pre-push): jaxlint must be clean,
+# a traced smoke run must produce VALID compact segments that convert
+# losslessly, and the OpenMetrics render/parse pair must round-trip.
+# Nonzero exit on the first failure (set -e + explicit asserts).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+TMP="$(mktemp -d "${TMPDIR:-/tmp}/lgbm_tpu_check.XXXXXX")"
+trap 'rm -rf "$TMP"' EXIT
+
+echo "== jaxlint =="
+python -m tools.jaxlint lightgbm_tpu
+
+echo "== traced smoke run (compact segments) =="
+LIGHTGBM_TPU_TRACE_STREAM="$TMP/trace" \
+LIGHTGBM_TPU_TRACE_FORMAT=compact \
+LIGHTGBM_TPU_TRACE_SEGMENT_BYTES=65536 \
+LIGHTGBM_TPU_TIMETAG=1 \
+python - <<'EOF'
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import trace
+
+rng = np.random.default_rng(0)
+X = rng.standard_normal((2000, 10)).astype(np.float32)
+y = (X[:, 0] + 0.1 * rng.standard_normal(2000) > 0).astype(np.float32)
+lgb.train({"objective": "binary", "num_leaves": 15, "max_bin": 63,
+           "verbosity": -1, "min_data_in_leaf": 20},
+          lgb.Dataset(X, label=y), num_boost_round=3)
+trace.flush()
+EOF
+
+python tools/trace_report.py validate "$TMP/trace"
+python tools/trace_report.py convert -o "$TMP/converted.json" "$TMP/trace"
+python tools/trace_report.py validate "$TMP/converted.json"
+
+echo "== OpenMetrics render/parse round-trip =="
+python - <<'EOF'
+from lightgbm_tpu.obs.export import render_openmetrics
+from lightgbm_tpu.obs.openmetrics import parse_openmetrics, metric_value
+from lightgbm_tpu.obs.registry import MetricsRegistry
+
+reg = MetricsRegistry()
+reg.enable()
+reg.inc("check/widgets", 3)
+reg.gauge("check/depth", 7.5)
+with reg.scope("check::stage"):
+    pass
+text = render_openmetrics(reg)
+parsed = parse_openmetrics(text)
+assert metric_value(parsed, "lightgbm_tpu_check_widgets_total") == 3.0
+assert metric_value(parsed, "lightgbm_tpu_check_depth") == 7.5
+assert parse_openmetrics(render_openmetrics(reg)) == parsed
+print("round-trip ok (%d samples)" % len(parsed))
+EOF
+
+echo "CHECK OK"

@@ -14,6 +14,15 @@ train()).
 
 Usage:  python tools/tpu_phase_timer.py [rows] [n_trees]
 Prints one JSON line per tree plus a summary (registry snapshot).
+
+Fleet mode:  python tools/tpu_phase_timer.py --from-metrics DUMP|URL
+Instead of running anything, read a metrics-gateway dump (a file, or a
+gateway URL to scrape — see lightgbm_tpu/obs/gateway.py) and print the
+per-rank phase table the fleet already reported: one JSON line per
+rank with its ``stage_seconds_total``/``stage_calls_total`` breakdown,
+plus a fleet summary (sources, push ages, run ids). This path parses
+OpenMetrics with the stdlib-pure ``obs/openmetrics.py`` loaded by file
+path and never imports jax.
 """
 from __future__ import annotations
 
@@ -25,7 +34,52 @@ sys.path.insert(0, __import__("os").path.join(
     ".."))
 
 
+def _from_metrics(src: str) -> None:
+    """Per-rank stage table from a gateway metrics dump — must run
+    BEFORE any jax import (the whole point of reading the dump is not
+    needing the hardware this tool normally drives)."""
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_report
+    om = trace_report._openmetrics()
+    text = trace_report.fetch_metrics_text(src)
+    parsed = om.parse_openmetrics(text)
+    pfx = om.kPrefix
+    per_rank: dict = {}
+    ages: dict = {}
+    run_ids = set()
+    for (name, labels), v in sorted(parsed.items()):
+        ld = dict(labels)
+        rank = str(ld.get("rank", "?"))
+        if name == pfx + "stage_seconds_total":
+            stage = per_rank.setdefault(rank, {}).setdefault(
+                str(ld.get("stage", "?")), {"s": 0.0, "calls": 0})
+            stage["s"] = round(stage["s"] + v, 4)
+        elif name == pfx + "stage_calls_total":
+            stage = per_rank.setdefault(rank, {}).setdefault(
+                str(ld.get("stage", "?")), {"s": 0.0, "calls": 0})
+            stage["calls"] = int(stage["calls"] + v)
+        elif name == pfx + "gateway_push_age_seconds":
+            ages["%s/%s" % (rank, ld.get("process", "?"))] = v
+        elif name == pfx + "run_info" and ld.get("run_id"):
+            run_ids.add(ld["run_id"])
+    for rank in sorted(per_rank):
+        print(json.dumps({"rank": rank, "phases": per_rank[rank]}),
+              flush=True)
+    print(json.dumps({"phase": "fleet", "source": src,
+                      "ranks": len(per_rank),
+                      "push_age_s": ages,
+                      "run_ids": sorted(run_ids)}), flush=True)
+
+
 def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--from-metrics":
+        if len(sys.argv) != 3:
+            print("usage: tpu_phase_timer.py --from-metrics DUMP|URL",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        _from_metrics(sys.argv[2])
+        return
     rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
     n_trees = int(sys.argv[2]) if len(sys.argv) > 2 else 3
 

@@ -9,7 +9,11 @@ Every PATH argument may be a single Chrome-trace JSON file OR a
 streaming segment DIRECTORY produced by
 ``LIGHTGBM_TPU_TRACE_STREAM=dir`` (``segment-r<rank>-<seq>.json``
 files, each a complete self-contained trace file — see
-``lightgbm_tpu/obs/trace.py``).
+``lightgbm_tpu/obs/trace.py``). Compact binary segments
+(``LIGHTGBM_TPU_TRACE_FORMAT=compact`` → ``.ctrace`` files, see
+``lightgbm_tpu/obs/trace_compact.py``) load transparently everywhere
+a JSON segment does — the codec module is stdlib-pure and loaded by
+file path, so the no-jax guarantee holds.
 
 Subcommands::
 
@@ -41,6 +45,19 @@ Subcommands::
         ``--spans`` prints every span of each new segment instead of
         the digest.
 
+    trace_report.py convert -o out.json seg.ctrace|segdir/|trace.json
+        Lossless conversion to Chrome-trace JSON: a compact segment
+        (or a directory mixing formats) comes out span-for-span equal
+        to what the JSON writer would have produced.
+
+    trace_report.py fleet segdir/ metrics.txt|http://gateway:port
+        Run-correlated fleet report: joins a trace-segment directory
+        with a gateway metrics dump (a file, or a live gateway URL to
+        scrape) into one JSON report — per-rank stage tables from
+        both sources, rank skew, push staleness, watchdog breach
+        counters, and whether the trace run_id matches the metrics
+        run_id.
+
 The traces come from ``LIGHTGBM_TPU_TRACE=path.json`` /
 ``LIGHTGBM_TPU_TRACE_STREAM=dir`` (see docs/OBSERVABILITY.md);
 multi-process dtrain writes one file per rank (``path.rankN.json``) or
@@ -62,17 +79,61 @@ kNestEpsUs = 5.0
 
 kKnownPhases = {"X", "i", "C", "M", "b", "e", "n"}
 
+# compact binary segments: the codec (and the OpenMetrics parser the
+# fleet report needs) are stdlib-pure modules inside the package,
+# loaded BY FILE PATH so this tool never imports lightgbm_tpu itself
+# (whose __init__ drags jax in)
+kCompactMagicPrefix = b"LGTPUCT"
+kCompactExt = ".ctrace"
+_OBS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "lightgbm_tpu", "obs")
+_side_modules: Dict[str, object] = {}
+
+
+def _load_side_module(name: str):
+    """Import ``lightgbm_tpu/obs/<name>.py`` standalone (no package)."""
+    mod = _side_modules.get(name)
+    if mod is None:
+        import importlib.util
+        path = os.path.join(_OBS_DIR, name + ".py")
+        if not os.path.isfile(path):
+            raise RuntimeError(
+                "%s not found next to trace_report.py (expected %s)"
+                % (name, path))
+        spec = importlib.util.spec_from_file_location(
+            "trace_report__" + name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _side_modules[name] = mod
+    return mod
+
+
+def _codec():
+    return _load_side_module("trace_compact")
+
+
+def _openmetrics():
+    return _load_side_module("openmetrics")
+
 
 def segment_files(dirpath: str) -> List[str]:
-    """Finalized segment files of a streaming trace directory, in
-    rotation order (the seq number is zero-padded, so lexical order is
-    per-rank rotation order)."""
-    return sorted(glob.glob(os.path.join(dirpath, "segment-*.json")))
+    """Finalized segment files of a streaming trace directory (JSON
+    and compact alike), in rotation order (the seq number is
+    zero-padded and precedes the extension, so lexical order is
+    per-rank rotation order even in a mixed-format directory)."""
+    return sorted(glob.glob(os.path.join(dirpath, "segment-*.json"))
+                  + glob.glob(os.path.join(dirpath,
+                                           "segment-*" + kCompactExt)))
 
 
 def load_file(path: str) -> dict:
-    """Load ONE Chrome-trace file; normalizes the bare-array form to
-    the object form."""
+    """Load ONE trace file — Chrome-trace JSON (bare-array form
+    normalized) or a compact binary segment (decoded to the identical
+    document shape)."""
+    with open(path, "rb") as f:
+        head = f.read(len(kCompactMagicPrefix))
+    if path.endswith(kCompactExt) or head == kCompactMagicPrefix:
+        return _codec().read_segment(path)
     with open(path) as f:
         doc = json.load(f)
     if isinstance(doc, list):
@@ -106,7 +167,7 @@ def load_dir(dirpath: str) -> dict:
     dropped-event counter seen (the spool's counter is cumulative)."""
     files = segment_files(dirpath)
     if not files:
-        raise ValueError("%s: no segment-*.json files" % dirpath)
+        raise ValueError("%s: no segment-*.{json,ctrace} files" % dirpath)
     docs = [load_file(f) for f in files]
     segs = [dict(d.get("otherData") or {}, source_file=f)
             for d, f in zip(docs, files)]
@@ -202,7 +263,7 @@ def validate_dir(dirpath: str) -> Tuple[List[str], dict]:
     them). Returns (errors, stats)."""
     files = segment_files(dirpath)
     if not files:
-        return (["%s: no segment-*.json files" % dirpath], {})
+        return (["%s: no segment-*.{json,ctrace} files" % dirpath], {})
     errors: List[str] = []
     for f in files:
         try:
@@ -287,7 +348,7 @@ def _merge_inputs(paths: List[str]) -> List[Tuple[str, dict]]:
             continue
         files = segment_files(path)
         if not files:
-            raise ValueError("%s: no segment-*.json files" % path)
+            raise ValueError("%s: no segment-*.{json,ctrace} files" % path)
         by_rank: Dict[object, List[dict]] = {}
         order: List[object] = []
         for f in files:
@@ -418,6 +479,106 @@ def segment_digest(path: str, doc: dict, top: int = 3) -> str:
                (" | " + heavy) if heavy else ""))
 
 
+def fetch_metrics_text(src: str) -> str:
+    """The OpenMetrics document for the ``fleet`` report: a dump file,
+    or a live gateway scraped over HTTP (``/metrics`` appended when the
+    URL has no path)."""
+    if "://" in src:
+        import urllib.parse
+        import urllib.request
+        if urllib.parse.urlsplit(src).path in ("", "/"):
+            src = src.rstrip("/") + "/metrics"
+        with urllib.request.urlopen(src, timeout=10) as resp:
+            return resp.read().decode("utf-8", errors="replace")
+    with open(src) as f:
+        return f.read()
+
+
+def fleet_report(tracedir: str, metrics_text: str,
+                 metrics_source: str = "") -> dict:
+    """Join a trace-segment directory with a gateway metrics dump into
+    one run-correlated report: per-rank stage tables from BOTH sources,
+    rank skew, push staleness, watchdog breach counters, and whether
+    the trace's run_id matches the gateway's."""
+    om = _openmetrics()
+    parsed = om.parse_openmetrics(metrics_text)
+    pfx = om.kPrefix
+
+    # -- metrics side: per-rank stage seconds, push ages, breaches ------
+    m_stage: Dict[str, Dict[str, float]] = {}
+    push_age: Dict[str, float] = {}
+    breaches: Dict[str, float] = {}
+    m_run_ids = set()
+    for (name, labels), v in sorted(parsed.items()):
+        ld = dict(labels)
+        if name == pfx + "stage_seconds_total":
+            per = m_stage.setdefault(str(ld.get("rank", "?")), {})
+            stage = str(ld.get("stage", "?"))
+            per[stage] = round(per.get(stage, 0.0) + v, 6)
+        elif name == pfx + "gateway_push_age_seconds":
+            push_age["%s/%s" % (ld.get("rank", "?"),
+                                ld.get("process", "?"))] = v
+        elif name == pfx + "run_info" and ld.get("run_id"):
+            m_run_ids.add(ld["run_id"])
+        elif (name.startswith(pfx + "health_")
+              and name.endswith("_total") and v > 0):
+            rule = name[len(pfx + "health_"):-len("_total")]
+            breaches[rule] = breaches.get(rule, 0.0) + v
+
+    # -- trace side: per-rank span tables + segment run ids -------------
+    by_rank: Dict[str, List[dict]] = {}
+    t_run_ids = set()
+    for f in segment_files(tracedir):
+        doc = load_file(f)
+        od = doc.get("otherData") or {}
+        if od.get("run_id"):
+            t_run_ids.add(str(od["run_id"]))
+        rank = od.get("process_index")
+        by_rank.setdefault(str(0 if rank is None else rank),
+                           []).append(doc)
+    t_stage = {rank: summarize(_concat_docs(docs, {}))["phases"]
+               for rank, docs in sorted(by_rank.items())}
+
+    # -- join ------------------------------------------------------------
+    ranks = {}
+    for rank in sorted(set(t_stage) | set(m_stage)):
+        trace_s = round(sum(p["seconds"]
+                            for p in t_stage.get(rank, {}).values()), 6)
+        metric_s = round(sum(m_stage.get(rank, {}).values()), 6)
+        ages = [a for k, a in push_age.items()
+                if k.split("/", 1)[0] == rank]
+        ranks[rank] = {
+            "trace_stage_seconds": t_stage.get(rank, {}),
+            "metrics_stage_seconds": m_stage.get(rank, {}),
+            "trace_seconds": trace_s,
+            "metrics_seconds": metric_s,
+            "push_age_s": min(ages) if ages else None,
+        }
+    totals = [(r, e["metrics_seconds"] or e["trace_seconds"])
+              for r, e in ranks.items()]
+    busy = [t for _r, t in totals if t > 0]
+    skew = {"ranks": len(totals)}
+    if len(busy) >= 2:
+        skew["slowest"] = round(max(busy), 6)
+        skew["fastest"] = round(min(busy), 6)
+        skew["ratio"] = round(max(busy) / min(busy), 3)
+    match = (sorted(t_run_ids & m_run_ids)
+             if t_run_ids and m_run_ids else [])
+    return {
+        "trace": {"dir": tracedir, "run_ids": sorted(t_run_ids),
+                  "segments": len(segment_files(tracedir))},
+        "metrics": {"source": metrics_source,
+                    "run_ids": sorted(m_run_ids),
+                    "push_age_s": push_age},
+        "ranks": ranks,
+        "rank_skew": skew,
+        "breaches": breaches,
+        "run_id_match": (bool(match) if t_run_ids and m_run_ids
+                         else None),
+        "run_ids_matched": match,
+    }
+
+
 def tail_dir(dirpath: str, follow: bool = False, interval: float = 2.0,
              print_spans: bool = False, out=None) -> int:
     """Print a digest (or every span) of each segment as it finalizes.
@@ -473,6 +634,18 @@ def main(argv=None) -> int:
     ap_t.add_argument("--spans", action="store_true",
                       help="print every span instead of per-segment "
                            "digests")
+    ap_c = sub.add_parser("convert",
+                          help="lossless convert (compact segments "
+                               "included) to Chrome-trace JSON")
+    ap_c.add_argument("-o", "--output", required=True)
+    ap_c.add_argument("path")
+    ap_f = sub.add_parser("fleet",
+                          help="run-correlated trace + gateway-metrics "
+                               "fleet report")
+    ap_f.add_argument("tracedir")
+    ap_f.add_argument("metrics",
+                      help="gateway metrics dump file, or gateway URL "
+                           "to scrape")
     args = ap.parse_args(argv)
 
     if args.cmd == "validate":
@@ -523,6 +696,33 @@ def main(argv=None) -> int:
         else:
             doc = merge_traces(args.paths)
         print(json.dumps(summarize(doc), indent=2))
+        return 0
+
+    if args.cmd == "convert":
+        try:
+            doc = load_trace(args.path)
+        except (OSError, ValueError) as e:
+            print("convert: %s" % e, file=sys.stderr)
+            return 1
+        with open(args.output, "w") as f:
+            json.dump(doc, f)
+        print("converted %s -> %s (%d events)"
+              % (args.path, args.output, len(doc.get("traceEvents", []))))
+        return 0
+
+    if args.cmd == "fleet":
+        if not os.path.isdir(args.tracedir):
+            print("fleet: %s is not a directory" % args.tracedir,
+                  file=sys.stderr)
+            return 2
+        try:
+            text = fetch_metrics_text(args.metrics)
+            report = fleet_report(args.tracedir, text,
+                                  metrics_source=args.metrics)
+        except (OSError, ValueError) as e:
+            print("fleet: %s" % e, file=sys.stderr)
+            return 1
+        print(json.dumps(report, indent=2))
         return 0
 
     return 2
