@@ -779,8 +779,12 @@ def run_chaos_bench() -> dict:
     training under a deterministic fault-injection schedule and prove
     the fault-tolerant plane absorbs it — 1 prefetch staging fault
     (retried), 1 spill ENOSPC fault (degraded to resident shards,
-    bit-identical model), and 1 SIGKILL mid-train + checkpoint resume
-    (bit-identical to the uninterrupted control run).
+    bit-identical model), 1 SIGKILL mid-train + checkpoint resume
+    (bit-identical to the uninterrupted control run), and the three
+    serving-plane sites of the unified chaos schedule
+    (``lightgbm_tpu.loop.chaos.SERVE_SITES``): one typed
+    ``serve_admit`` rejection, one ``serve_dispatch`` canary rollback
+    with the stable version untouched, one ``gateway_push`` retried.
 
     First-class keys: ``chaos_faults_injected`` (total injected),
     ``chaos_recovered`` (faults the run absorbed without dying),
@@ -863,7 +867,57 @@ def run_chaos_bench() -> dict:
            resident_shards=len(ds_chaos._resident_shards),
            retries=obs_registry.count("ft/retries"))
 
-    # ---- leg 2: SIGKILL mid-train + resume --------------------------
+    # ---- leg 2: serving-plane sites of the unified schedule ---------
+    # (loop/chaos.py SERVE_SITES — the same sites the refresh harness
+    # fires mid-loop; here they run against a quiet server so each
+    # outcome is attributable to exactly one injection)
+    from lightgbm_tpu.loop.chaos import SERVE_SITES
+    from lightgbm_tpu.obs.gateway import MetricsGateway, SnapshotPusher
+    from lightgbm_tpu.serve import ModelRegistry, PredictServer
+
+    assert set(SERVE_SITES) == {"serve_admit", "serve_dispatch",
+                                "gateway_push"}
+    rb0 = obs_registry.count("serve/rollbacks")
+    reg = ModelRegistry()
+    v1 = reg.load("chaos", booster=b_clean)
+    srv = PredictServer(reg, name="chaos", max_batch=128, max_wait_ms=2)
+    Xs = np.ascontiguousarray(X[:64], dtype=np.float32)
+    srv.predict(Xs, timeout=120)          # warm the bucket
+    faults.configure("serve_admit:nth:1")
+    try:
+        try:
+            srv.predict(Xs, timeout=120)
+            admit_ok = False              # the injection was swallowed
+        except OSError:                   # typed: InjectedFault is an
+            admit_ok = True               # OSError, like a real EMFILE
+    finally:
+        faults.reset()
+    reg.load("chaos", booster=b_clean, canary_batches=2)
+    faults.configure("serve_dispatch:nth:1")
+    try:
+        srv.predict(Xs, timeout=120)      # rolls back, replays on v1
+    finally:
+        faults.reset()
+    dispatch_ok = (obs_registry.count("serve/rollbacks") - rb0 == 1
+                   and reg.get("chaos")[0] == v1)
+    srv.stop()
+    gw = MetricsGateway(port=0)
+    pusher = SnapshotPusher(gw.url, interval=0, role="bench")
+    retries0 = obs_registry.count("ft/retries")
+    faults.configure("gateway_push:nth:1")
+    try:
+        pusher.push_now()                 # retried; never raises
+    finally:
+        faults.reset()
+        gw.close()
+    push_ok = obs_registry.count("ft/retries") > retries0
+    serve_ok = admit_ok and dispatch_ok and push_ok
+    faults_survived += int(admit_ok) + int(dispatch_ok) + int(push_ok)
+    _stage("chaos_serve_done", admit_ok=admit_ok,
+           dispatch_ok=dispatch_ok, push_ok=push_ok,
+           rollbacks=obs_registry.count("serve/rollbacks") - rb0)
+
+    # ---- leg 3: SIGKILL mid-train + resume --------------------------
     ckdir = os.path.join(work, "ck")
     child = textwrap.dedent("""\
         import os, signal
@@ -915,7 +969,7 @@ def run_chaos_bench() -> dict:
     overhead_pct = 100.0 * (t_resume - t_fair) / max(t_fair, 1e-9)
 
     injected = obs_registry.count("ft/faults_injected") - injected0
-    recovered_all = faults_ok and resume_ok
+    recovered_all = faults_ok and resume_ok and serve_ok
     _stage("chaos_done", injected=injected,
            recovered=faults_survived,
            resume_overhead_pct=round(overhead_pct, 1),
@@ -926,7 +980,9 @@ def run_chaos_bench() -> dict:
         "metric": "chaos_recovered",
         "value": faults_survived,
         "unit": "faults survived of %d injected on %s (1 spill ENOSPC "
-                "degrade + 1 prefetch retry + 1 SIGKILL@iter%d/%d "
+                "degrade + 1 prefetch retry + 1 typed admit reject + "
+                "1 canary rollback + 1 gateway-push retry + "
+                "1 SIGKILL@iter%d/%d "
                 "resume; models bit-identical: %s; resume leg %+.0f%% "
                 "vs uninterrupted)"
                 % (injected, platform, kill_at, iters, recovered_all,
@@ -936,6 +992,108 @@ def run_chaos_bench() -> dict:
         "chaos_recovered": faults_survived,
         "chaos_resume_overhead_pct": round(overhead_pct, 1),
         "chaos_bit_identical": bool(recovered_all),
+    }
+
+
+def run_refresh_bench() -> dict:
+    """Closed-loop refresh stage (``python bench.py refresh`` or
+    BENCH_REFRESH=1): run the continuous train → publish → serve →
+    retrain loop (lightgbm_tpu/loop/) for BENCH_REFRESH_CYCLES total
+    cycles under sustained generated traffic, with the unified chaos
+    schedule firing mid-loop — one poisoned canary that must roll back
+    while the previous version keeps serving, one retryable train-side
+    fault, one telemetry push fault.
+
+    First-class keys: ``refresh_cycle_seconds`` (mean wall seconds per
+    refresh cycle: attach + resumed training + device refit + canary
+    publish), ``serve_p99_during_refresh_ms`` (worst per-cycle serve
+    p99 while the loop ran), ``refresh_slo_breaches`` (firings of the
+    ``refresh_slo`` watchdog rule), ``refresh_rollbacks`` (canary
+    rollbacks — must equal the schedule's poisoned count exactly).
+    Exit nonzero on any SLO breach, lost fault, stranded future, or a
+    cycle that ended in the wrong outcome.
+
+    Env knobs: BENCH_REFRESH_ROWS (20k per window),
+    BENCH_REFRESH_CYCLES (4 = bootstrap + 3 refreshes),
+    BENCH_REFRESH_BASE_ROUNDS (6), BENCH_REFRESH_EXTRA_ROUNDS (2),
+    BENCH_REFRESH_THREADS (2 traffic pumps),
+    LIGHTGBM_TPU_WATCH_REFRESH_P99_MS (serve p99 SLO; the bench
+    defaults it to 1000 ms because the CI box shares its cores between
+    the resumed training step and the serving plane — re-tighten on a
+    real accelerator)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from lightgbm_tpu.loop import RefreshController
+    from lightgbm_tpu.obs import health as obs_health
+    from lightgbm_tpu.obs.registry import registry as obs_registry
+
+    _enable_compile_cache()
+    platform = jax.devices()[0].platform
+    obs_registry.enable()
+    obs_health.record_backend(platform, source="bench_refresh")
+    os.environ.setdefault("LIGHTGBM_TPU_WATCH_REFRESH_P99_MS", "1000")
+
+    rows = int(os.environ.get("BENCH_REFRESH_ROWS", 20_000))
+    cycles = int(os.environ.get("BENCH_REFRESH_CYCLES", 4))
+    base = int(os.environ.get("BENCH_REFRESH_BASE_ROUNDS", 6))
+    extra = int(os.environ.get("BENCH_REFRESH_EXTRA_ROUNDS", 2))
+    threads = int(os.environ.get("BENCH_REFRESH_THREADS", 2))
+    n_feat = 28
+    params = {"objective": "binary", "num_leaves": 31, "max_bin": 255,
+              "verbosity": -1, "min_data_in_leaf": 20,
+              "bin_construct_sample_cnt": 20_000}
+
+    def data_fn(cycle):
+        return make_higgs_like(rows, n_feat, seed=7 + cycle)
+
+    work = tempfile.mkdtemp(prefix="lgbm_tpu_refresh_")
+    _stage("refresh_start", rows=rows, cycles=cycles,
+           base_rounds=base, extra_rounds=extra)
+    try:
+        ctl = RefreshController(params, data_fn, num_features=n_feat,
+                                work_dir=work, base_rounds=base,
+                                extra_rounds=extra,
+                                traffic_threads=threads)
+        report = ctl.run(cycles=cycles)
+    finally:
+        if not os.environ.get("BENCH_REFRESH_KEEP"):
+            shutil.rmtree(work, ignore_errors=True)
+    for rec in report["cycles"]:
+        _stage("refresh_cycle", **rec)
+    _stage("refresh_done", ok=report["ok"],
+           rollbacks=report["refresh_rollbacks"],
+           slo_breaches=report["refresh_slo_breaches"],
+           stranded=report["stranded_futures"],
+           faults_injected=report["faults_injected"],
+           traffic_requests=report["traffic"].get("requests", 0),
+           problems="; ".join(report["problems"]))
+    return {
+        "metric": "refresh_cycle_seconds",
+        "value": report["refresh_cycle_seconds"],
+        "unit": "s/refresh-cycle on %s (%d cycles; p99 %.1f ms under "
+                "%d traffic pumps; %d/%d scheduled rollbacks; %d SLO "
+                "breaches; %d stranded; %d faults injected%s)"
+                % (platform, report["num_cycles"],
+                   report["serve_p99_during_refresh_ms"], threads,
+                   report["refresh_rollbacks"],
+                   report["expected_rollbacks"],
+                   report["refresh_slo_breaches"],
+                   report["stranded_futures"],
+                   report["faults_injected"],
+                   "" if report["ok"] else "; PROBLEMS: "
+                   + "; ".join(report["problems"])),
+        "backend": platform,
+        "refresh_cycle_seconds": report["refresh_cycle_seconds"],
+        "serve_p99_during_refresh_ms":
+            report["serve_p99_during_refresh_ms"],
+        "refresh_slo_breaches": report["refresh_slo_breaches"],
+        "refresh_rollbacks": report["refresh_rollbacks"],
+        "refresh_stranded_futures": report["stranded_futures"],
+        "refresh_faults_injected": report["faults_injected"],
+        "refresh_ok": bool(report["ok"]),
     }
 
 
@@ -1741,6 +1899,30 @@ def main() -> None:
             sys.exit(1)
         print(json.dumps(result))
         if not result.get("chaos_bit_identical"):
+            sys.exit(1)
+        return
+    if (os.environ.get("BENCH_REFRESH")
+            or (len(sys.argv) > 1 and sys.argv[1] == "refresh")):
+        # refresh stage: the closed loop's contracts (rollback under
+        # traffic, SLO watchdog, zero stranded) are backend-agnostic
+        if os.environ.get("JAX_PLATFORMS") in (None, "") \
+                and not os.environ.get("PALLAS_AXON_POOL_IPS"):
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            result = run_refresh_bench()
+        except Exception as e:
+            result = {"metric": "refresh_cycle_seconds", "value": 0.0,
+                      "unit": "s/refresh-cycle (FAILED: %s: %s)"
+                              % (type(e).__name__, str(e)[:300]),
+                      "refresh_cycle_seconds": 0.0,
+                      "serve_p99_during_refresh_ms": 0.0,
+                      "refresh_slo_breaches": -1,
+                      "refresh_rollbacks": -1,
+                      "refresh_ok": False}
+            print(json.dumps(result))
+            sys.exit(1)
+        print(json.dumps(result))
+        if not result["refresh_ok"]:
             sys.exit(1)
         return
     if (os.environ.get("BENCH_SERVE")

@@ -503,6 +503,46 @@ class Booster:
         return predictor.predict(X, output_kind=kind)
 
     # ------------------------------------------------------------------
+    def refit(self, data, label, weight=None,
+              decay_rate: Optional[float] = None) -> "Booster":
+        """Recompute every leaf value from ``(data, label)`` over the
+        FROZEN tree structure (reference: Booster.refit →
+        GBDT::RefitTree) — the refresh loop's incremental update. Runs
+        as a pure device replay: one stacked-forest leaf walk plus
+        per-leaf ``segment_sum`` gradient statistics
+        (``boosting/refit.py:refit_model_device``), no host tree walk.
+        Mutates this booster in place and returns it; the packed
+        predict cache re-keys itself off the leaf-value fingerprint.
+
+        ``decay_rate`` defaults to ``config.refit_decay_rate``:
+        ``new = decay*old + (1-decay)*shrinkage*optimum`` per leaf.
+        """
+        from .boosting.refit import refit_model_device
+        X = np.asarray(data, dtype=np.float64)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        y = np.asarray(label, dtype=np.float64)
+        if X.shape[0] != len(y):
+            raise ValueError("refit data has %d rows but %d labels"
+                             % (X.shape[0], len(y)))
+        if decay_rate is None:
+            decay_rate = float(self.config.refit_decay_rate)
+        inner = self.inner
+        # refit freezes structure and the stacked walk reads ONLY
+        # structure, so one packed forest serves every refit cycle
+        # until training appends trees (leaf values ride separately)
+        key = (len(inner.models),
+               sum(t.num_leaves for t in inner.models))
+        cached = getattr(self, "_refit_forest", None)
+        if cached is None or cached[0] != key:
+            from .serve import StackedForest
+            cached = (key, StackedForest.from_gbdt(inner))
+            self._refit_forest = cached
+        refit_model_device(inner, X, y, weight=weight,
+                           decay_rate=decay_rate, forest=cached[1])
+        return self
+
+    # ------------------------------------------------------------------
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":
         ni = self._resolve_num_iteration(num_iteration)
@@ -545,6 +585,7 @@ class Booster:
         state.pop("inner", None)
         state.pop("_train_set", None)
         state.pop("_stacked_cache", None)  # device arrays don't pickle
+        state.pop("_refit_forest", None)
         return state
 
     def __setstate__(self, state):

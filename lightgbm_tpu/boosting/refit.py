@@ -64,3 +64,81 @@ def refit_model(gbdt, X: np.ndarray, y: np.ndarray,
 
 def _threshold_l1(s: float, l1: float) -> float:
     return np.sign(s) * max(abs(s) - l1, 0.0)
+
+
+def refit_model_device(gbdt, X: np.ndarray, y: np.ndarray,
+                       weight: np.ndarray = None,
+                       decay_rate: float = 0.9, forest=None) -> None:
+    """Device replay of :func:`refit_model`: the whole forest's leaf
+    assignment comes from ONE stacked-forest walk, per-leaf gradient
+    statistics are ``segment_sum`` reductions (``ops/refit.py``), and
+    the updated [T, NL] leaf table crosses back to the host exactly
+    once. No host tree walk; transfer-guard clean once warmed (the
+    score buffer and the old leaf values stage through explicit
+    ``jax.device_put``, every loop scalar rides ``utils/scalars``).
+
+    ``forest`` may carry a pre-built :class:`~..serve.StackedForest`
+    over the SAME tree list — refit freezes structure, so callers in a
+    refresh loop reuse one forest across every cycle and skip the pack.
+
+    Device sums run in f32 (x64 stays off), so leaf values agree with
+    the f64 host oracle to documented tolerance (docs/REFRESH.md), not
+    bit-exactly.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..serve.forest import StackedForest
+    from ..ops.refit import refit_tree_step
+    from ..utils import next_pow2
+    from ..utils.scalars import dev_f32, dev_i32
+
+    models = gbdt.models
+    if not models:
+        return
+    y = np.asarray(y, dtype=np.float64)
+    config = gbdt.config
+    objective = gbdt.objective
+    if objective is None:
+        objective = create_objective(config.objective, config)
+    from ..io.dataset import Metadata
+    md = Metadata(len(y))
+    md.set_label(y)
+    if weight is not None:
+        md.set_weights(np.asarray(weight, dtype=np.float64))
+    objective.init(md, len(y))
+
+    if forest is None:
+        forest = StackedForest.from_gbdt(gbdt)
+    leaf_ids = forest.leaves_device(X)          # [T, n], stays on device
+    T = len(models)
+    K = gbdt.num_tree_per_iteration
+    NL = int(next_pow2(max(t.num_leaves for t in models)))
+    old = np.zeros((T, NL), dtype=np.float32)
+    for i, t in enumerate(models):
+        old[i, :t.num_leaves] = t.leaf_value[:t.num_leaves]
+    old_dev = jax.device_put(old)
+    n = len(y)
+    shape = (n,) if K == 1 else (n, K)
+    score = jax.device_put(np.zeros(shape, dtype=np.float32))
+
+    l1 = dev_f32(float(config.lambda_l1))
+    l2 = dev_f32(float(config.lambda_l2))
+    mds = float(config.max_delta_step)
+    max_delta = dev_f32(mds if mds > 0 else float("inf"))
+    shrink = dev_f32(float(gbdt.shrinkage_rate))
+    decay = dev_f32(float(decay_rate))
+    new_rows = []
+    for i in range(T):
+        g, h = objective.get_gradients(score)
+        row, score = refit_tree_step(
+            score, g, h, dev_i32(i % K), dev_i32(i), leaf_ids, old_dev,
+            NL, l1, l2, max_delta, shrink, decay)
+        new_rows.append(row)
+    # jaxlint: disable=JLT001 -- refit read-back: the updated [T, NL]
+    # leaf table leaves the device exactly once per refit, by design
+    vals = np.asarray(jax.device_get(jnp.stack(new_rows)),
+                      dtype=np.float64)
+    for i, tree in enumerate(models):
+        for leaf in range(tree.num_leaves):
+            tree.set_leaf_output(leaf, float(vals[i, leaf]))

@@ -255,6 +255,49 @@ class BinMapper:
         self.most_freq_bin: int = 0
 
     # ------------------------------------------------------------------
+    # manifest (de)serialization: the sharded spill manifest embeds its
+    # mappers so a spill dir reopens WITHOUT the source data
+    # (ShardedBinnedDataset.attach). JSON-safe: upper bounds serialize
+    # repr-exactly as floats (inf/nan ride through Python's json, which
+    # emits Infinity/NaN literals and parses them back), categorical
+    # keys stringify and convert back on load.
+    def to_dict(self) -> dict:
+        return {
+            "num_bin": int(self.num_bin),
+            "missing_type": int(self.missing_type),
+            "is_trivial": bool(self.is_trivial),
+            "sparse_rate": float(self.sparse_rate),
+            "bin_type": int(self.bin_type),
+            "bin_upper_bound": [float(v) for v in self.bin_upper_bound],
+            "bin_2_categorical": [int(v) for v in self.bin_2_categorical],
+            "categorical_2_bin": {str(k): int(v) for k, v
+                                  in self.categorical_2_bin.items()},
+            "min_val": float(self.min_val),
+            "max_val": float(self.max_val),
+            "default_bin": int(self.default_bin),
+            "most_freq_bin": int(self.most_freq_bin),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BinMapper":
+        m = cls()
+        m.num_bin = int(d["num_bin"])
+        m.missing_type = int(d["missing_type"])
+        m.is_trivial = bool(d["is_trivial"])
+        m.sparse_rate = float(d["sparse_rate"])
+        m.bin_type = int(d["bin_type"])
+        m.bin_upper_bound = np.asarray(d["bin_upper_bound"],
+                                       dtype=np.float64)
+        m.bin_2_categorical = [int(v) for v in d["bin_2_categorical"]]
+        m.categorical_2_bin = {int(k): int(v) for k, v
+                               in d["categorical_2_bin"].items()}
+        m.min_val = float(d["min_val"])
+        m.max_val = float(d["max_val"])
+        m.default_bin = int(d["default_bin"])
+        m.most_freq_bin = int(d["most_freq_bin"])
+        return m
+
+    # ------------------------------------------------------------------
     @_scoped("io::find_bin")
     def find_bin(self, sample_values: np.ndarray, total_sample_cnt: int,
                  max_bin: int, min_data_in_bin: int = 3,

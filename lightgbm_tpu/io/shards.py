@@ -465,6 +465,11 @@ class ShardedBinnedDataset:
             # is rejected loudly by name at reopen, never trained on
             "files": self._file_meta,
             "resident_shards": sorted(self._resident_shards),
+            # the full quantizer state: attach() reopens this spill
+            # without the source data and without re-binning
+            "feature_names": self.feature_names,
+            "used_feature_map": self.used_feature_map,
+            "mappers": [m.to_dict() for m in self.bin_mappers],
         }
         try:
             atomic_write(os.path.join(self.spill_dir, "manifest.json"),
@@ -484,6 +489,128 @@ class ShardedBinnedDataset:
             aligned_to_reference=False, sharded=True,
             num_shards=self.num_shards, shard_rows=shard_rows)
         return self
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def attach(cls, spill_dir: str,
+               config: Optional[Config] = None) -> "ShardedBinnedDataset":
+        """Reopen an existing spill dir WITHOUT the source data and
+        without re-binning: the manifest carries the full quantizer
+        state (bin mappers, feature maps), shard files stay on disk and
+        reopen memory-mapped exactly as after construction. Labels and
+        weights reload from the per-shard aux files, each verified
+        against the manifest's content hash before use.
+
+        This is the refresh loop's cheap data plane for cycle N+1 (and
+        the elastic-resume primitive): training from an attached
+        dataset is bit-identical to training from the dataset that
+        spilled it. ``config`` resolves the constraint/penalty vectors
+        (monotone_constraints, feature_penalty) — pass the training
+        config; defaults to ``Config()`` (no constraints).
+        """
+        self = cls()
+        self.spill_dir = str(spill_dir)
+        mpath = os.path.join(self.spill_dir, "manifest.json")
+        try:
+            with open(mpath) as fh:
+                manifest = json.load(fh)
+        except (OSError, ValueError) as e:
+            log.fatal("cannot attach spill dir %s: manifest unreadable "
+                      "(%r)" % (self.spill_dir, e))
+        if "mappers" not in manifest:
+            log.fatal("spill manifest under %s predates mapper "
+                      "serialization; rebuild via from_chunk_source"
+                      % self.spill_dir)
+        if manifest.get("resident_shards"):
+            # a degraded (ENOSPC) build kept shards in RAM only — they
+            # were never written, so this spill cannot be reattached
+            log.fatal("spill under %s is degraded (shards %s were "
+                      "host-resident, never spilled); it cannot be "
+                      "reattached" % (self.spill_dir,
+                                      manifest["resident_shards"]))
+        n = int(manifest["num_data"])
+        self.num_total_features = int(manifest["num_total_features"])
+        self.feature_names = list(manifest["feature_names"])
+        self.bin_mappers = [BinMapper.from_dict(d)
+                            for d in manifest["mappers"]]
+        self.used_feature_map = [int(i)
+                                 for i in manifest["used_feature_map"]]
+        if (len(self.bin_mappers) != int(manifest["num_features_used"])
+                or len(self.used_feature_map) != len(self.bin_mappers)):
+            log.fatal("spill manifest under %s is inconsistent: %d "
+                      "mappers, %d used features, used map of %d"
+                      % (self.spill_dir, len(self.bin_mappers),
+                         int(manifest["num_features_used"]),
+                         len(self.used_feature_map)))
+        self.num_bin_per_feature = np.asarray(
+            [m.num_bin for m in self.bin_mappers], dtype=np.int32)
+        self.max_num_bin = int(manifest["max_num_bin"])
+        derived = int(self.num_bin_per_feature.max()) \
+            if len(self.num_bin_per_feature) else 1
+        if derived != self.max_num_bin:
+            log.fatal("spill manifest under %s is inconsistent: "
+                      "max_num_bin %d but mappers peak at %d"
+                      % (self.spill_dir, self.max_num_bin, derived))
+        self.bins_dtype = np.dtype(manifest["bins_dtype"]).type
+        self.shard_sizes = [int(s) for s in manifest["shard_sizes"]]
+        if sum(self.shard_sizes) != n:
+            log.fatal("spill manifest under %s is inconsistent: shard "
+                      "sizes sum to %d, num_data is %d"
+                      % (self.spill_dir, sum(self.shard_sizes), n))
+        self.shard_offsets = list(
+            np.concatenate([[0], np.cumsum(self.shard_sizes)[:-1]])
+            .astype(int))
+        self.has_weights = bool(manifest["has_weight"])
+        self._file_meta = {str(k): dict(v)
+                           for k, v in manifest["files"].items()}
+        # every manifest-listed file must exist at its recorded size
+        # BEFORE any training starts (content hashes verify lazily on
+        # first open, same as after construction)
+        for name, meta in self._file_meta.items():
+            path = os.path.join(self.spill_dir, name)
+            try:
+                size = os.path.getsize(path)
+            except OSError as e:
+                log.fatal("attach: shard file %s under %s is missing "
+                          "or unreadable: %r"
+                          % (name, self.spill_dir, e))
+            if size != int(meta["bytes"]):
+                log.fatal("attach: shard file %s is truncated: %d "
+                          "bytes on disk, manifest records %d"
+                          % (name, size, int(meta["bytes"])))
+        BinnedDataset._set_constraints(self, config or Config())
+        self.metadata = Metadata(n)
+        if manifest["has_label"]:
+            self.metadata.set_label(np.concatenate(
+                [self._load_aux(self._label_path(k))
+                 for k in range(self.num_shards)]))
+        if self.has_weights:
+            self.metadata.set_weights(np.concatenate(
+                [self._load_aux(self._weight_path(k))
+                 for k in range(self.num_shards)]))
+        obs_events.emit(
+            "dataset_attach", spill_dir=self.spill_dir, num_data=n,
+            num_features=self.num_features,
+            num_shards=self.num_shards,
+            max_num_bin=self.max_num_bin)
+        return self
+
+    def _load_aux(self, path: str) -> np.ndarray:
+        """Load one label/weight shard file, content-verified against
+        the manifest hash (aux files are [n_k] f32 — small enough to
+        hash eagerly on attach, unlike the lazily-verified bins)."""
+        name = os.path.basename(path)
+        meta = self._file_meta.get(name)
+        if meta is None:
+            log.fatal("attach: %s is not in the spill manifest under %s"
+                      % (name, self.spill_dir))
+        digest = sha256_file(path)
+        if digest != meta["sha256"]:
+            log.fatal("attach: %s under %s failed content verification "
+                      "(sha256 %s..., manifest records %s...)"
+                      % (name, self.spill_dir, digest[:12],
+                         meta["sha256"][:12]))
+        return np.load(path)
 
     # ------------------------------------------------------------------
     def _build_mappers(self, sample_X: np.ndarray, sample_cnt_eff: int,

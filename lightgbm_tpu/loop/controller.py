@@ -1,0 +1,482 @@
+"""Closed-loop continuous model refresh: train → publish → serve →
+retrain, under live traffic, with the fault plane firing mid-loop.
+
+The controller composes subsystems that already exist — the streaming
+spill path (io/streaming.py → io/shards.py), checkpointed training
+(engine.py + ft/checkpoint.py), the device refit replay
+(boosting/refit.py:refit_model_device via ``Booster.refit``), and the
+canary-publishing registry + micro-batching server (serve/server.py) —
+into ONE loop and asserts the composition's invariants every cycle:
+
+- the serving plane answers throughout (generated traffic never stops;
+  a refresh is invisible to callers except as a version bump);
+- a poisoned refresh rolls back inside its canary window while the
+  previous version keeps serving (fail-closed publish);
+- train-side and telemetry-side injected faults are absorbed by the
+  retry/degrade machinery without losing the cycle;
+- the ``refresh_slo`` watchdog rule (obs/health.py) sees zero breaches
+  on a healthy loop: serve p99 under the SLO, rollbacks within budget,
+  zero stranded futures at drain.
+
+Data flows in per-cycle *windows* (``data_fn(cycle) -> (X, y[, w])``).
+Cycle 0 streams window 0 through the spill path, trains the base model
+with checkpoints, and publishes it into a live :class:`PredictServer`.
+Every later cycle re-opens the SAME spill directory via
+``ShardedBinnedDataset.attach`` (no re-binning), resumes training from
+the newest checkpoint for ``extra_rounds`` more iterations, refits the
+grown forest's leaf values on the cycle's fresh window entirely on
+device, and canary-publishes the refreshed model under traffic.
+
+See docs/REFRESH.md for the SLO contract and what is NOT covered.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..basic import Booster, Dataset
+from ..config import Config
+from ..engine import train as _train
+from ..io.shards import ShardedBinnedDataset
+from ..io.streaming import StreamingDataset
+from ..obs import events
+from ..obs import faults
+from ..obs import gateway as obs_gateway
+from ..obs import health as obs_health
+from ..obs.registry import registry as obs_registry
+from ..serve import ModelRegistry, Overloaded, PredictServer, ServeError
+from ..utils import log
+from . import chaos as chaos_mod
+
+
+class TrafficGenerator:
+    """Sustained synthetic serving load: ``threads`` daemon threads pump
+    one block each through ``server.predict`` in a tight loop, counting
+    answered rows and TYPED failures (an untyped failure is a bug).
+
+    ``pause()``/``resume()`` quiesce the pumps without stopping the
+    server — the poisoned-publish leg needs the NEXT dispatch to be the
+    canary's deterministically, which live pumps can't guarantee. Each
+    pump is synchronous (``predict`` blocks on its own Future), so once
+    every thread reports idle there are zero generator requests in
+    flight."""
+
+    def __init__(self, server: PredictServer, block: np.ndarray,
+                 threads: int = 2, timeout_s: float = 120.0) -> None:
+        self.server = server
+        self.block = block
+        self.timeout_s = float(timeout_s)
+        self.n_threads = max(int(threads), 1)
+        self._stop = threading.Event()
+        self._pause = threading.Event()
+        self._idle = [threading.Event() for _ in range(self.n_threads)]
+        self._threads: List[threading.Thread] = []
+        # per-thread stats, merged at read time (no locks on the pump)
+        self._stats = [{"requests": 0, "rows_ok": 0, "shed": 0,
+                        "typed": {}, "untyped": []}
+                       for _ in range(self.n_threads)]
+
+    def _pump(self, t: int) -> None:
+        st = self._stats[t]
+        while not self._stop.is_set():
+            if self._pause.is_set():
+                self._idle[t].set()
+                time.sleep(0.002)
+                continue
+            self._idle[t].clear()
+            st["requests"] += 1
+            try:
+                self.server.predict(self.block, timeout=self.timeout_s)
+                st["rows_ok"] += self.block.shape[0]
+            except Overloaded:
+                st["shed"] += 1
+            except (ServeError, faults.InjectedFault) as e:
+                name = type(e).__name__
+                st["typed"][name] = st["typed"].get(name, 0) + 1
+            except Exception as e:  # noqa: BLE001 — count, never die:
+                # a dead pump would silently end "sustained traffic"
+                if len(st["untyped"]) < 8:
+                    st["untyped"].append("%s: %s" % (type(e).__name__,
+                                                     str(e)[:120]))
+
+    def start(self) -> None:
+        self._threads = [threading.Thread(target=self._pump, args=(t,),
+                                          daemon=True)
+                         for t in range(self.n_threads)]
+        for th in self._threads:
+            th.start()
+
+    def pause(self, timeout_s: float = 30.0) -> bool:
+        """Quiesce every pump; True once no generator request is in
+        flight (each pump parked in its poll loop)."""
+        for ev in self._idle:
+            ev.clear()
+        self._pause.set()
+        deadline = time.time() + timeout_s
+        for ev in self._idle:
+            if not ev.wait(timeout=max(deadline - time.time(), 0.001)):
+                return False
+        return True
+
+    def resume(self) -> None:
+        self._pause.clear()
+
+    def stats(self) -> Dict:
+        out = {"requests": 0, "rows_ok": 0, "shed": 0,
+               "typed": {}, "untyped": []}
+        for st in self._stats:
+            out["requests"] += st["requests"]
+            out["rows_ok"] += st["rows_ok"]
+            out["shed"] += st["shed"]
+            for k, v in st["typed"].items():
+                out["typed"][k] = out["typed"].get(k, 0) + v
+            out["untyped"].extend(st["untyped"])
+        return out
+
+    def stop(self) -> Dict:
+        self._stop.set()
+        self._pause.clear()
+        for th in self._threads:
+            th.join(timeout=max(self.timeout_s, 30.0))
+        return self.stats()
+
+
+class RefreshController:
+    """Drive the closed refresh loop; see the module docstring.
+
+    ``data_fn(cycle)`` supplies each cycle's window as ``(X, y)`` or
+    ``(X, y, weight)`` host arrays. ``params`` is the ordinary
+    ``lgb.train`` params dict (iteration-count aliases must stay out of
+    it — the loop owns the round schedule: ``base_rounds`` at
+    bootstrap, ``+ extra_rounds`` per refresh cycle, resumed from the
+    newest checkpoint)."""
+
+    def __init__(self, params: Dict, data_fn: Callable,
+                 num_features: int, work_dir: str,
+                 base_rounds: int = 6, extra_rounds: int = 2,
+                 canary_batches: int = 2, name: str = "refresh",
+                 traffic_threads: int = 2, traffic_rows: int = 64,
+                 schedule: Optional[Dict[int, List[chaos_mod.ChaosLeg]]]
+                 = None,
+                 use_gateway: bool = True, checkpoint_freq: int = 1,
+                 shard_rows: Optional[int] = None,
+                 drain_timeout_s: float = 30.0,
+                 canary_timeout_s: float = 60.0,
+                 max_batch: int = 256, max_wait_ms: float = 2.0) -> None:
+        self.params = dict(params)
+        self.data_fn = data_fn
+        self.num_features = int(num_features)
+        self.work_dir = work_dir
+        self.spill_dir = os.path.join(work_dir, "spill")
+        self.ckpt_dir = os.path.join(work_dir, "ckpt")
+        self.base_rounds = int(base_rounds)
+        self.extra_rounds = int(extra_rounds)
+        self.canary_batches = int(canary_batches)
+        self.name = name
+        self.traffic_threads = int(traffic_threads)
+        self.traffic_rows = int(traffic_rows)
+        self.schedule = schedule
+        self.use_gateway = bool(use_gateway)
+        self.checkpoint_freq = int(checkpoint_freq)
+        self.shard_rows = shard_rows
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.canary_timeout_s = float(canary_timeout_s)
+        self.max_batch = int(max_batch)
+        self.max_wait_ms = float(max_wait_ms)
+
+        self.registry = ModelRegistry()
+        self.server: Optional[PredictServer] = None
+        self.traffic: Optional[TrafficGenerator] = None
+        self.watchdog: Optional[obs_health.Watchdog] = None
+        self._gateway = None
+        self._pusher = None
+        self._block: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    def _window(self, cycle: int):
+        out = self.data_fn(cycle)
+        if len(out) == 2:
+            X, y = out
+            w = None
+        else:
+            X, y, w = out
+        return (np.asarray(X, dtype=np.float64),
+                np.asarray(y, dtype=np.float64),
+                None if w is None else np.asarray(w, dtype=np.float64))
+
+    def _wrap(self, sharded) -> Dataset:
+        # Dataset.construct() early-returns on a bound handle, so the
+        # engine's checkpoint/resume machinery drives the sharded
+        # dataset without ever re-binning raw data it does not have
+        ds = Dataset(None)
+        ds._handle = sharded
+        ds.params = dict(self.params)
+        return ds
+
+    def _await_canary(self, version: int) -> str:
+        deadline = time.time() + self.canary_timeout_s
+        while (self.registry.canary_active(self.name)
+               and time.time() < deadline):
+            time.sleep(0.01)
+        if self.registry.canary_active(self.name):
+            return "stuck"
+        return ("promoted"
+                if self.registry.get(self.name)[0] == version
+                else "rolled_back")
+
+    # ------------------------------------------------------------------
+    def _bootstrap(self) -> Dict:
+        t0 = time.perf_counter()
+        X0, y0, w0 = self._window(0)
+        sd = StreamingDataset(self.num_features, params=self.params,
+                              has_weight=w0 is not None)
+        chunk = max(len(y0) // 4, 1)
+        for lo in range(0, len(y0), chunk):
+            sd.push_rows(X0[lo:lo + chunk], label=y0[lo:lo + chunk],
+                         weight=(None if w0 is None
+                                 else w0[lo:lo + chunk]))
+        sharded = sd.finalize(
+            spill_dir=self.spill_dir,
+            shard_rows=self.shard_rows or max(len(y0) // 4, 1))
+        bst = _train(dict(self.params), self._wrap(sharded),
+                     num_boost_round=self.base_rounds,
+                     checkpoint_dir=self.ckpt_dir,
+                     checkpoint_freq=self.checkpoint_freq)
+        version = self.registry.load(self.name, booster=bst)
+        self.server = PredictServer(self.registry, name=self.name,
+                                    max_batch=self.max_batch,
+                                    max_wait_ms=self.max_wait_ms)
+        self._block = np.ascontiguousarray(X0[:self.traffic_rows],
+                                           dtype=np.float32)
+        self.server.predict(self._block, timeout=120)  # warm the bucket
+        self.traffic = TrafficGenerator(self.server, self._block,
+                                        threads=self.traffic_threads)
+        self.traffic.start()
+        seconds = time.perf_counter() - t0
+        rec = {"cycle": 0, "outcome": "bootstrap", "version": version,
+               "stable_version": version, "seconds": round(seconds, 3),
+               "rounds": self.base_rounds, "chaos": [], "injected": 0,
+               "p99_ms": self.server.latency_percentiles()["p99"]}
+        events.emit("refresh_cycle", **rec)
+        return rec
+
+    def _poisoned_publish(self, model_str: str, spec: str,
+                          problems: List[str]):
+        """Publish a canary that is SCHEDULED to die: quiesce the
+        generator pumps (so the injected ``serve_dispatch`` fault can
+        only land on the canary's first batch), publish, drive one
+        request through the window, and let the rollback-and-replay
+        machinery answer it on the stable version. Traffic resumes the
+        instant the rollback is in the registry — the server itself
+        never stopped."""
+        if not self.traffic.pause():
+            problems.append("could not quiesce traffic for the "
+                            "poisoned publish")
+        faults.configure(spec)
+        version = None
+        try:
+            version = self.registry.load(
+                self.name, model_str=model_str,
+                canary_batches=self.canary_batches)
+            try:
+                # rolls back, then replays THIS batch on stable
+                self.server.predict(self._block, timeout=120)
+            except (ServeError, faults.InjectedFault) as e:
+                problems.append(
+                    "poisoned canary did not replay on stable: %s: %s"
+                    % (type(e).__name__, str(e)[:120]))
+        finally:
+            faults.reset()
+            self.traffic.resume()
+        outcome = self._await_canary(version)
+        return outcome, version
+
+    def _refresh_cycle(self, cycle: int,
+                       legs: List[chaos_mod.ChaosLeg],
+                       problems: List[str]) -> Dict:
+        t0 = time.perf_counter()
+        inj0 = obs_registry.count("ft/faults_injected")
+        train_spec = ";".join(l.spec for l in legs
+                              if l.phase == "train")
+        pub_legs = [l for l in legs if l.phase == "publish"]
+        tele_spec = ";".join(l.spec for l in legs
+                             if l.phase == "telemetry")
+        poison = any(l.poison for l in pub_legs)
+
+        # --- retrain: reopen the spill (no re-binning) + resume -------
+        attached = ShardedBinnedDataset.attach(
+            self.spill_dir, config=Config.from_params(self.params))
+        rounds = self.base_rounds + self.extra_rounds * cycle
+        if train_spec:
+            faults.configure(train_spec)
+        try:
+            bst = _train(dict(self.params), self._wrap(attached),
+                         num_boost_round=rounds,
+                         checkpoint_dir=self.ckpt_dir,
+                         checkpoint_freq=self.checkpoint_freq,
+                         resume=True)
+        finally:
+            if train_spec:
+                faults.reset()
+
+        # --- refit on the fresh window (pure device replay) ----------
+        Xw, yw, ww = self._window(cycle)
+        bst.refit(Xw, yw, weight=ww)
+        model_str = bst.model_to_string()
+
+        # --- canary publish into the LIVE server ---------------------
+        prev_version = self.registry.get(self.name)[0]
+        if poison:
+            spec = ";".join(l.spec for l in pub_legs)
+            outcome, version = self._poisoned_publish(
+                model_str, spec, problems)
+        else:
+            pub_spec = ";".join(l.spec for l in pub_legs)
+            if pub_spec:
+                faults.configure(pub_spec)
+            try:
+                version = self.registry.load(
+                    self.name, model_str=model_str,
+                    canary_batches=self.canary_batches)
+                # live traffic drives the canary window to a verdict
+                outcome = self._await_canary(version)
+            finally:
+                if pub_spec:
+                    faults.reset()
+
+        # --- telemetry push (fault-injectable, retried, never fatal) -
+        if self._pusher is not None:
+            if tele_spec:
+                faults.configure(tele_spec)
+            try:
+                self._pusher.push_now()
+            finally:
+                if tele_spec:
+                    faults.reset()
+
+        # --- per-cycle SLO evaluation ---------------------------------
+        stable = self.registry.get(self.name)[0]
+        p99 = self.server.latency_percentiles()["p99"]
+        obs_registry.gauge("refresh/serve_p99_ms", p99)
+        obs_registry.gauge("refresh/stable_version", stable)
+        fired = self.watchdog.evaluate()
+        injected = obs_registry.count("ft/faults_injected") - inj0
+
+        if poison:
+            if outcome != "rolled_back":
+                problems.append("cycle %d: poisoned canary %s "
+                                "(expected rolled_back)"
+                                % (cycle, outcome))
+            elif stable != prev_version:
+                problems.append("cycle %d: rollback left stable v%s "
+                                "(expected v%s to keep serving)"
+                                % (cycle, stable, prev_version))
+        elif outcome != "promoted":
+            problems.append("cycle %d: clean refresh %s (expected "
+                            "promoted)" % (cycle, outcome))
+        if legs and injected == 0:
+            problems.append("cycle %d: scheduled fault(s) %s never "
+                            "fired" % (cycle,
+                                       [l.spec for l in legs]))
+
+        rec = {"cycle": cycle, "outcome": outcome, "version": version,
+               "stable_version": stable,
+               "seconds": round(time.perf_counter() - t0, 3),
+               "rounds": rounds, "chaos": [l.spec for l in legs],
+               "injected": injected, "p99_ms": round(p99, 3),
+               "breaches": [f["rule"] for f in fired]}
+        events.emit("refresh_cycle", **rec)
+        return rec
+
+    # ------------------------------------------------------------------
+    def run(self, cycles: int) -> Dict:
+        """Run ``cycles`` total cycles (cycle 0 bootstraps; each later
+        cycle is a refresh) and return the loop report. The report's
+        ``ok`` is the whole contract: every scheduled outcome happened,
+        every scheduled fault fired, zero ``refresh_slo`` breaches,
+        zero stranded futures, zero untyped traffic failures."""
+        if cycles < 2:
+            raise ValueError("a closed loop needs >= 2 cycles "
+                             "(bootstrap + at least one refresh)")
+        schedule = (self.schedule if self.schedule is not None
+                    else chaos_mod.refresh_schedule(cycles))
+        chaos_mod.validate_schedule(schedule)
+        obs_registry.enable()
+        rb0 = obs_registry.count("serve/rollbacks")
+        drain0 = obs_registry.count("serve/drain_failed")
+        slo0 = obs_registry.count("health/refresh_slo")
+        inj0 = obs_registry.count("ft/faults_injected")
+
+        if self.use_gateway:
+            self._gateway = obs_gateway.MetricsGateway(port=0)
+            self._pusher = obs_gateway.SnapshotPusher(
+                self._gateway.url, interval=0, role="refresh")
+
+        self.watchdog = obs_health.Watchdog(obs_registry)
+        obs_registry.gauge("refresh/active", 1)
+        self.watchdog.evaluate()   # arm: baseline the counter deltas
+
+        problems: List[str] = []
+        records: List[Dict] = []
+        try:
+            records.append(self._bootstrap())
+            for cycle in range(1, cycles):
+                records.append(self._refresh_cycle(
+                    cycle, schedule.get(cycle, []), problems))
+        finally:
+            traffic = self.traffic.stop() if self.traffic else {}
+            if self.server is not None:
+                self.server.stop(self.drain_timeout_s)
+            # stranded-future check runs with the loop still "active"
+            # (the refresh_slo rule disarms once the gauge clears)
+            if self.watchdog is not None:
+                self.watchdog.evaluate()
+            obs_registry.gauge("refresh/active", 0)
+            if self._gateway is not None:
+                self._gateway.close()
+
+        if traffic.get("untyped"):
+            problems.append("untyped traffic failures: %s"
+                            % "; ".join(traffic["untyped"][:4]))
+        rollbacks = obs_registry.count("serve/rollbacks") - rb0
+        expected_rb = chaos_mod.expected_rollbacks(schedule)
+        if rollbacks != expected_rb:
+            problems.append("%d rollbacks (schedule expected %d)"
+                            % (rollbacks, expected_rb))
+        stranded = obs_registry.count("serve/drain_failed") - drain0
+        if stranded:
+            problems.append("%d futures stranded at drain" % stranded)
+        slo_breaches = obs_registry.count("health/refresh_slo") - slo0
+        if slo_breaches:
+            problems.append("%d refresh_slo breaches" % slo_breaches)
+        for p in problems:
+            log.warning("refresh loop: %s" % p)
+
+        refresh_secs = [r["seconds"] for r in records if r["cycle"] > 0]
+        report = {
+            "cycles": records,
+            "num_cycles": len(records),
+            "refresh_cycle_seconds": round(
+                float(np.mean(refresh_secs)) if refresh_secs else 0.0,
+                3),
+            "serve_p99_during_refresh_ms": round(
+                max((r["p99_ms"] for r in records), default=0.0), 3),
+            "refresh_slo_breaches": int(slo_breaches),
+            "refresh_rollbacks": int(rollbacks),
+            "expected_rollbacks": int(expected_rb),
+            "stranded_futures": int(stranded),
+            "faults_injected": obs_registry.count("ft/faults_injected")
+            - inj0,
+            "traffic": traffic,
+            "problems": problems,
+            "ok": not problems,
+        }
+        events.emit("refresh_done", ok=report["ok"],
+                    num_cycles=report["num_cycles"],
+                    rollbacks=rollbacks, slo_breaches=slo_breaches,
+                    stranded=stranded)
+        return report

@@ -75,12 +75,18 @@ class ObjectiveFunction:
 
     # ------------------------------------------------------------------
     def init(self, metadata, num_data: int) -> None:
-        """Bind training metadata (reference: ObjectiveFunction::Init)."""
+        """Bind training metadata (reference: ObjectiveFunction::Init).
+
+        Label/weight staging is an EXPLICIT ``jax.device_put``: the
+        refresh loop re-inits objectives per refit window under a
+        warmed ``jax.transfer_guard("disallow")``, where implicit
+        ``jnp.asarray`` transfers raise (same contract as
+        utils/scalars.py for loop scalars)."""
         self.num_data = num_data
-        self.label = jnp.asarray(
+        self.label = jax.device_put(
             np.asarray(metadata.label, dtype=np.float32))
         if metadata.weights is not None:
-            self.weights = jnp.asarray(
+            self.weights = jax.device_put(
                 np.asarray(metadata.weights, dtype=np.float32))
         else:
             self.weights = None

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -66,9 +67,10 @@ class BinaryLogloss(ObjectiveFunction):
                 pos_weight = cnt_negative / cnt_positive
         pos_weight *= self.scale_pos_weight
         # precompute per-row signed label (+-1) and label weight
-        self.label_sign = jnp.asarray(
+        # explicit staging: refit re-inits under transfer_guard
+        self.label_sign = jax.device_put(
             np.where(is_pos, 1.0, -1.0).astype(np.float32))
-        self.label_weight = jnp.asarray(
+        self.label_weight = jax.device_put(
             np.where(is_pos, pos_weight, neg_weight).astype(np.float32))
         self._is_pos_np = is_pos
 

@@ -49,7 +49,7 @@ class MulticlassSoftmax(ObjectiveFunction):
     def init(self, metadata, num_data: int) -> None:
         super().init(metadata, num_data)
         li = np.asarray(metadata.label).astype(np.int32)
-        self.label_onehot = jnp.asarray(
+        self.label_onehot = jax.device_put(
             np.eye(self.num_class, dtype=np.float32)[li])
 
     def _jit_key(self):

@@ -104,10 +104,11 @@ class LambdarankNDCG(ObjectiveFunction):
             m = dcg.max_dcg_at_k(self.truncation_level,
                                  label_np[qb[q]:qb[q + 1]], self.label_gain)
             inv[q] = 1.0 / m if m > 0.0 else 0.0
-        self.inverse_max_dcgs = jnp.asarray(inv.astype(np.float32))
-        self.gain_table = jnp.asarray(self.label_gain.astype(np.float32))
+        self.inverse_max_dcgs = jax.device_put(inv.astype(np.float32))
+        self.gain_table = jax.device_put(
+            self.label_gain.astype(np.float32))
         L = self.layout.max_len
-        self.discount_table = jnp.asarray(
+        self.discount_table = jax.device_put(
             dcg.discounts(max(L, 1)).astype(np.float32))
 
     def _jit_key(self):

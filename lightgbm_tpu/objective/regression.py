@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -52,7 +53,7 @@ class RegressionL2(ObjectiveFunction):
             raw = np.asarray(metadata.label, dtype=np.float64)
             self._raw_label = raw
             trans = np.sign(raw) * np.sqrt(np.abs(raw))
-            self.label = jnp.asarray(trans.astype(np.float32))
+            self.label = jax.device_put(trans.astype(np.float32))
 
     @obs_compile.instrument_jit_method("obj.regression_l2.grads")
     def _grads(self, score, label, weights):
@@ -306,7 +307,7 @@ class RegressionMAPE(RegressionL1):
                 "Some label values are < 1 in absolute value. MAPE is "
                 "unstable with such values, so LightGBM rounds them to "
                 "1.0 when computing MAPE.")
-        self.label_weight = jnp.asarray(lw.astype(np.float32))
+        self.label_weight = jax.device_put(lw.astype(np.float32))
         self._label_weight_np = lw
 
     @property

@@ -147,7 +147,19 @@ def default_rules() -> List[WatchRule]:
       half-open probe closes it;
     - backend fallback, trace drops, and exhausted retries
       (``retry_exhausted`` — some I/O site gave up after its bounded
-      attempts, utils/retry.py) fire on ANY new occurrence.
+      attempts, utils/retry.py) fire on ANY new occurrence;
+    - ``refresh_slo`` — the continuous-refresh contract
+      (lightgbm_tpu/loop/, docs/REFRESH.md), armed ONLY while the
+      ``refresh/active`` gauge is truthy (the RefreshController sets
+      it around its loop and evaluates once at arm time to baseline
+      the counters): serving p99 during a refresh
+      (``refresh/serve_p99_ms`` gauge) at or above
+      ``LIGHTGBM_TPU_WATCH_REFRESH_P99_MS`` (default 250), more
+      rollbacks in one refresh window than the
+      ``LIGHTGBM_TPU_WATCH_REFRESH_ROLLBACKS`` budget (default 1 —
+      the chaos schedule's single poisoned canary is expected, a
+      second rollback is not), or ANY stranded future
+      (``serve/drain_failed`` delta) is a breach.
     """
     retrace_thr = _env_float("LIGHTGBM_TPU_WATCH_RETRACE_SPIKE", 8)
     queue_thr = _env_float("LIGHTGBM_TPU_WATCH_QUEUE_DEPTH", 1024)
@@ -294,6 +306,47 @@ def default_rules() -> List[WatchRule]:
                               "being rejected fast"}
         return None
 
+    refresh_p99_thr = _env_float("LIGHTGBM_TPU_WATCH_REFRESH_P99_MS",
+                                 250)
+    refresh_rb_budget = _env_float(
+        "LIGHTGBM_TPU_WATCH_REFRESH_ROLLBACKS", 1)
+
+    def refresh_slo(snap, state):
+        # the closed-loop refresh contract: armed only while the
+        # refresh/active gauge is up. Counter baselines keep tracking
+        # while idle, so history before a refresh window can never
+        # fire; the per-window rollback accumulator resets when the
+        # window closes.
+        gauges = snap.get("gauges", {})
+        rb = _counter_delta(snap, state,
+                            frozenset(("serve/rollbacks",)),
+                            "prev_rb", first_is_baseline=True)
+        stranded = _counter_delta(snap, state,
+                                  frozenset(("serve/drain_failed",)),
+                                  "prev_drain", first_is_baseline=True)
+        if not gauges.get("refresh/active"):
+            state.pop("rb_window", None)
+            return None
+        state["rb_window"] = state.get("rb_window", 0.0) + rb
+        if stranded > 0:
+            return {"value": stranded, "threshold": 1,
+                    "detail": "%d futures stranded by a server drain "
+                              "during a refresh window" % stranded}
+        if state["rb_window"] > refresh_rb_budget:
+            return {"value": state["rb_window"],
+                    "threshold": refresh_rb_budget,
+                    "detail": "%d canary rollbacks in one refresh "
+                              "window exceed the budget of %d"
+                              % (state["rb_window"], refresh_rb_budget)}
+        p99 = float(gauges.get("refresh/serve_p99_ms", 0.0))
+        if p99 >= refresh_p99_thr:
+            return {"value": round(p99, 3),
+                    "threshold": refresh_p99_thr,
+                    "detail": "serving p99 %.1f ms during a refresh "
+                              "window (SLO %.0f ms)"
+                              % (p99, refresh_p99_thr)}
+        return None
+
     return [WatchRule("retrace_spike", retrace_spike),
             WatchRule("backend_fallback", backend_fallback),
             WatchRule("queue_saturation", queue_saturation),
@@ -302,7 +355,8 @@ def default_rules() -> List[WatchRule]:
             WatchRule("retry_exhausted", retry_exhausted),
             WatchRule("fault_storm", fault_storm),
             WatchRule("shed_rate", shed_rate),
-            WatchRule("breaker_open", breaker_open)]
+            WatchRule("breaker_open", breaker_open),
+            WatchRule("refresh_slo", refresh_slo)]
 
 
 def fleet_rules() -> List[WatchRule]:

@@ -1,0 +1,71 @@
+"""Device refit kernel: per-leaf gradient statistics over a frozen forest.
+
+The reference's ``GBDT::RefitTree`` (src/boosting/gbdt.cpp:250) walks
+every tree on the host, row by row. Here the leaf assignment for ALL
+trees comes from one stacked-forest walk (``ops/predict.py`` via
+``serve.StackedForest.leaves_device``), and each tree's per-leaf
+gradient/hessian sums are ``jax.ops.segment_sum`` reductions — a pure
+device replay. One jitted step with a stable signature serves every
+tree (the tree index and class index ride in as traced device scalars),
+so a T-tree refit costs one trace, T dispatches, and a single read-back
+of the updated [T, NL] leaf table at the end.
+
+Precision: the device sums run in f32 (the repo does not enable x64),
+while the host oracle (``boosting/refit.py:refit_model``) accumulates in
+f64 — parity is within the documented tolerance (docs/REFRESH.md), not
+bit-exact. The serialized model text IS exact for what the device
+computed: leaf values round-trip through the shortest-round-trip decimal
+formatter unchanged.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..obs import compile as obs_compile
+
+
+def _refit_tree_step(score, g, h, k, ti, leaf_ids, old_vals, num_leaves,
+                     l1, l2, max_delta, shrinkage, decay):
+    """One tree of the refit replay.
+
+    score      [n] (K==1) or [n, K] f32 running raw scores (device) —
+               rank matches what the objective's get_gradients takes,
+               so the caller never slices eagerly between steps
+    g, h       [n] or [n, K] gradients/hessians for the CURRENT score
+    k          traced i32    class index (tree ti's column)
+    ti         traced i32    tree index into the stacked arrays
+    leaf_ids   [T, n] i32    stacked leaf assignment (frozen structure)
+    old_vals   [T, NL] f32   current leaf values
+    num_leaves static int    NL (padded; segment count)
+    l1/l2/max_delta/shrinkage/decay: traced f32 scalars
+
+    Returns (new_vals [NL], score') — the closed-form regularized leaf
+    optimum over the rows landing in each leaf, decay-mixed with the old
+    value (reference: feature_histogram.hpp CalculateSplittedLeafOutput;
+    config.h:524 refit_decay_rate). Empty leaves keep their old value,
+    same as the host oracle's ``if not rows.any(): continue``.
+    """
+    ids = jnp.take(leaf_ids, ti, axis=0)
+    old = jnp.take(old_vals, ti, axis=0)
+    gk = g if g.ndim == 1 else jnp.take(g, k, axis=1)
+    hk = h if h.ndim == 1 else jnp.take(h, k, axis=1)
+    sg = jax.ops.segment_sum(gk, ids, num_segments=num_leaves)
+    sh = jax.ops.segment_sum(hk, ids, num_segments=num_leaves)
+    cnt = jax.ops.segment_sum(jnp.ones_like(gk), ids,
+                              num_segments=num_leaves)
+    thresholded = jnp.sign(sg) * jnp.maximum(jnp.abs(sg) - l1, 0.0)
+    out = -thresholded / (sh + l2)
+    # max_delta_step arrives as +inf when disabled: clip is the identity
+    out = jnp.clip(out, -max_delta, max_delta)
+    mixed = decay * old + (1.0 - decay) * shrinkage * out
+    new_vals = jnp.where(cnt > 0, mixed, old)
+    if score.ndim == 1:
+        score = score + jnp.take(new_vals, ids)
+    else:
+        score = score.at[:, k].add(jnp.take(new_vals, ids))
+    return new_vals, score
+
+
+refit_tree_step = obs_compile.instrument_jit(
+    "refit.tree_step", _refit_tree_step, static_argnums=(7,))

@@ -500,6 +500,13 @@ class StackedForest:
         return stacked_forest_leaves(Xd, self._qt, self._nodes,
                                      self._cat_lut, self.trips)
 
+    def leaves_device(self, X, dd=None):
+        """[T, n] leaf ids ON device, no host sync — the refit replay's
+        entry point (``boosting/refit.py:refit_model_device`` feeds
+        these straight into per-leaf ``segment_sum`` reductions);
+        :meth:`leaves` is the host-facing wrapper."""
+        return self._leaves_device(X, dd)
+
     def leaves(self, X, dd=None) -> np.ndarray:
         """[n, T] leaf index of every row in every tree (one device
         dispatch for quantize + forest walk)."""

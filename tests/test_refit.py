@@ -1,0 +1,208 @@
+"""Booster.refit: the device replay vs the host f64 oracle.
+
+The device path (boosting/refit.py:refit_model_device via
+``Booster.refit``) must produce the same leaf values as the host oracle
+(``refit_model``) to the documented tolerance (docs/REFRESH.md — the
+device segment-sums run in f32, the oracle accumulates in f64), leave
+the tree STRUCTURE bit-identical, stay transfer-guard clean once
+warmed, and round-trip through model text → ModelRegistry → device
+predictions without changing a bit.
+"""
+import copy
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.boosting.refit import refit_model
+from lightgbm_tpu.serve import ModelRegistry, StackedForest
+
+# f32 device sums vs the f64 host oracle (docs/REFRESH.md): measured
+# divergence is ~1e-8 on these sizes; the asserted tolerance leaves
+# room for less friendly gradient distributions
+kRefitRtol = 2e-3
+kRefitAtol = 2e-4
+
+
+def _make(objective="binary", rows=3000, n_feat=10, num_class=1,
+          iters=5, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(rows, n_feat))
+    if objective == "multiclass":
+        y = (np.abs(X[:, 0] * 2 + X[:, 1]) % num_class).astype(int)
+        params = {"objective": "multiclass", "num_class": num_class}
+    elif objective == "regression":
+        y = X[:, 0] + 0.3 * X[:, 1] ** 2 + 0.1 * rng.normal(size=rows)
+        params = {"objective": "regression"}
+    else:
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0.2).astype(float)
+        params = {"objective": "binary"}
+    params.update({"num_leaves": 15, "verbosity": -1,
+                   "min_data_in_leaf": 20, "max_bin": 63})
+    bst = lgb.train(params, lgb.Dataset(X, label=y),
+                    num_boost_round=iters)
+    Xn = rng.normal(size=(rows // 2, n_feat))
+    if objective == "multiclass":
+        yn = (np.abs(Xn[:, 0] * 2 + Xn[:, 1]) % num_class).astype(int)
+    elif objective == "regression":
+        yn = Xn[:, 0] + 0.3 * Xn[:, 1] ** 2
+    else:
+        yn = (Xn[:, 0] + 0.5 * Xn[:, 1] > 0.2).astype(float)
+    return bst, Xn, yn
+
+
+def _structure(gbdt):
+    """The frozen part of every tree: split topology, thresholds,
+    features (sliced to the live internal nodes — padded capacity may
+    legitimately differ across save/load round trips)."""
+    out = []
+    for t in gbdt.models:
+        ni = t.num_leaves - 1
+        out.append((t.num_leaves,
+                    np.array(t.split_feature[:ni]),
+                    np.array(t.threshold[:ni]),
+                    np.array(t.left_child[:ni]),
+                    np.array(t.right_child[:ni])))
+    return out
+
+
+def _assert_structure_equal(a, b):
+    assert len(a) == len(b)
+    for (nl_a, *arrs_a), (nl_b, *arrs_b) in zip(a, b):
+        assert nl_a == nl_b
+        for x, y in zip(arrs_a, arrs_b):
+            np.testing.assert_array_equal(x, y)
+
+
+@pytest.mark.parametrize("objective", ["binary", "regression"])
+def test_refit_matches_host_oracle(objective):
+    bst, Xn, yn = _make(objective)
+    oracle = copy.deepcopy(bst.inner)
+    refit_model(oracle, Xn, yn, decay_rate=0.9)
+
+    before = _structure(bst.inner)
+    bst.refit(Xn, yn, decay_rate=0.9)
+    _assert_structure_equal(before, _structure(bst.inner))
+
+    for td, th in zip(bst.inner.models, oracle.models):
+        np.testing.assert_allclose(
+            td.leaf_value[:td.num_leaves],
+            th.leaf_value[:th.num_leaves],
+            rtol=kRefitRtol, atol=kRefitAtol)
+
+
+def test_refit_multiclass_matches_host_oracle():
+    bst, Xn, yn = _make("multiclass", num_class=3, iters=4)
+    oracle = copy.deepcopy(bst.inner)
+    refit_model(oracle, Xn, yn, decay_rate=0.9)
+    bst.refit(Xn, yn, decay_rate=0.9)
+    assert len(bst.inner.models) == 12   # 4 iterations x 3 classes
+    for td, th in zip(bst.inner.models, oracle.models):
+        np.testing.assert_allclose(
+            td.leaf_value[:td.num_leaves],
+            th.leaf_value[:th.num_leaves],
+            rtol=kRefitRtol, atol=kRefitAtol)
+
+
+def test_refit_decay_semantics():
+    bst, Xn, yn = _make()
+    original = [np.array(t.leaf_value[:t.num_leaves])
+                for t in bst.inner.models]
+    # decay 1.0: the old values survive unchanged (sanitized floats
+    # round-trip through set_leaf_output exactly)
+    frozen = copy.deepcopy(bst)
+    frozen.refit(Xn, yn, decay_rate=1.0)
+    for t, old in zip(frozen.inner.models, original):
+        np.testing.assert_allclose(t.leaf_value[:t.num_leaves], old,
+                                   rtol=1e-6, atol=1e-7)
+    # decay 0.0 actually moves them
+    moved = copy.deepcopy(bst)
+    moved.refit(Xn, yn, decay_rate=0.0)
+    deltas = [np.abs(t.leaf_value[:t.num_leaves] - old).max()
+              for t, old in zip(moved.inner.models, original)]
+    assert max(deltas) > 1e-4
+
+
+def test_refit_weighted_shifts_leaves():
+    bst, Xn, yn = _make()
+    plain = copy.deepcopy(bst)
+    plain.refit(Xn, yn)
+    w = np.where(yn > 0, 10.0, 0.1)
+    weighted = copy.deepcopy(bst)
+    weighted.refit(Xn, yn, weight=w)
+    deltas = [np.abs(a.leaf_value[:a.num_leaves]
+                     - b.leaf_value[:b.num_leaves]).max()
+              for a, b in zip(plain.inner.models,
+                              weighted.inner.models)]
+    assert max(deltas) > 1e-5
+    for t in weighted.inner.models:
+        assert np.all(np.isfinite(t.leaf_value[:t.num_leaves]))
+
+
+def test_refit_empty_leaves_keep_old_values():
+    bst, Xn, yn = _make()
+    original = [np.array(t.leaf_value[:t.num_leaves])
+                for t in bst.inner.models]
+    # a 3-row window cannot populate every leaf of every tree
+    bst.refit(Xn[:3], yn[:3], decay_rate=0.5)
+    kept = 0
+    for t, old in zip(bst.inner.models, original):
+        kept += int(np.sum(np.isclose(t.leaf_value[:t.num_leaves], old,
+                                      rtol=1e-6, atol=1e-7)))
+    assert kept > 0  # empty leaves held their pre-refit values
+
+
+def test_refit_model_text_roundtrip_bit_identical():
+    """Refitted model → model text → ModelRegistry → the served device
+    predictions are bit-identical to the refitted booster's own device
+    predictions (the text formatter is shortest-round-trip)."""
+    bst, Xn, yn = _make()
+    bst.refit(Xn, yn)
+    direct = np.asarray(
+        StackedForest.from_gbdt(bst).predict(Xn, raw_score=True))
+
+    reg = ModelRegistry()
+    reg.load("refit", model_str=bst.model_to_string())
+    _, forest = reg.get("refit")
+    served = np.asarray(forest.predict(Xn, raw_score=True))
+    np.testing.assert_array_equal(served, direct)
+
+
+def test_refit_forest_cache_reused_across_cycles():
+    """Refit freezes structure, so Booster.refit's stacked forest is
+    packed once and replayed for every later window."""
+    bst, Xn, yn = _make()
+    bst.refit(Xn, yn)
+    cached = bst._refit_forest
+    assert cached is not None
+    bst.refit(Xn[:500], yn[:500])
+    assert bst._refit_forest[1] is cached[1]
+
+
+def test_refit_transfer_guard_clean_once_warmed():
+    """A warmed refit performs NO implicit host↔device transfer: the
+    leaf walk, segment sums, and score updates all stay on device;
+    only the explicit device_put stagings and the single end-of-refit
+    read-back cross, both allowed under the guard."""
+    import jax
+
+    bst, Xn, yn = _make()
+    bst.refit(Xn, yn)                     # warm: traces + dev scalars
+    with jax.transfer_guard("disallow"):
+        bst.refit(Xn, yn + 0.0)           # same shapes, fresh window
+    for t in bst.inner.models:
+        assert np.all(np.isfinite(t.leaf_value[:t.num_leaves]))
+
+
+def test_refit_single_trace_for_the_whole_forest():
+    """One jitted step serves every tree: a T-tree refit must not add
+    more than one trace per score rank (the tree/class indices ride in
+    as traced scalars)."""
+    from lightgbm_tpu.obs import compile as obs_compile
+
+    bst, Xn, yn = _make(iters=6)
+    t0 = obs_compile.trace_counts().get("refit.tree_step", 0)
+    bst.refit(Xn, yn)
+    bst.refit(Xn[:1500], yn[:1500])       # new n → one retrace, reused
+    t1 = obs_compile.trace_counts().get("refit.tree_step", 0)
+    assert t1 - t0 <= 2

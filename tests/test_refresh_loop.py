@@ -1,0 +1,224 @@
+"""The closed refresh loop (lightgbm_tpu/loop/): train → publish →
+serve → retrain under live traffic, with chaos firing mid-loop.
+
+Tier-1 keeps one short two-cycle loop (bootstrap + one POISONED refresh
+— rollback-under-traffic is the property the loop exists to prove) plus
+the deterministic publish/checkpoint interleave; the longer multi-cycle
+scenarios are ``slow``.
+"""
+import os
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ft import checkpoint as ckpt_mod
+from lightgbm_tpu.loop import (ChaosLeg, RefreshController,
+                               expected_rollbacks, refresh_schedule,
+                               validate_schedule)
+from lightgbm_tpu.obs import faults
+from lightgbm_tpu.obs.registry import registry as obs_registry
+from lightgbm_tpu.serve import ModelRegistry, PredictServer
+
+kFeatures = 10
+kParams = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+           "verbosity": -1, "min_data_in_leaf": 10,
+           "bin_construct_sample_cnt": 800}
+
+
+def _data_fn(cycle, rows=800):
+    rng = np.random.default_rng(40 + cycle)
+    X = rng.normal(size=(rows, kFeatures))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0.2).astype(np.float64)
+    return X, y
+
+
+def _run(tmp, cycles, **kw):
+    kw.setdefault("base_rounds", 2)
+    kw.setdefault("extra_rounds", 1)
+    kw.setdefault("traffic_threads", 2)
+    kw.setdefault("traffic_rows", 32)
+    kw.setdefault("drain_timeout_s", 15)
+    ctl = RefreshController(kParams, _data_fn, num_features=kFeatures,
+                            work_dir=tmp, **kw)
+    return ctl, ctl.run(cycles=cycles)
+
+
+def test_schedule_shape():
+    sched = refresh_schedule(4)
+    validate_schedule(sched)
+    assert sorted(sched) == [1, 2, 3]
+    assert expected_rollbacks(sched) == 1
+    # the poisoned leg leads: a 2-cycle loop still proves rollback
+    assert refresh_schedule(2)[1][0].poison
+    with pytest.raises(ValueError):
+        validate_schedule({1: [ChaosLeg("no_such_site:nth:1",
+                                        "train", False)]})
+
+
+def test_two_cycle_loop_poisoned_refresh_rolls_back(tmp_path):
+    """Bootstrap + one poisoned refresh: the canary dies on the
+    injected dispatch fault, v1 keeps serving, traffic never sees an
+    untyped failure, nothing strands, no SLO breach."""
+    os.environ.setdefault("LIGHTGBM_TPU_WATCH_REFRESH_P99_MS", "5000")
+    ctl, rep = _run(str(tmp_path), cycles=2)
+    assert rep["ok"], rep["problems"]
+    assert rep["num_cycles"] == 2
+    assert rep["refresh_rollbacks"] == 1
+    assert rep["expected_rollbacks"] == 1
+    assert rep["stranded_futures"] == 0
+    assert rep["refresh_slo_breaches"] == 0
+    assert rep["traffic"]["rows_ok"] > 0
+    assert not rep["traffic"]["untyped"]
+    c1 = rep["cycles"][1]
+    assert c1["outcome"] == "rolled_back"
+    assert c1["stable_version"] == rep["cycles"][0]["version"]
+    assert c1["injected"] >= 1
+    # the loop's spill + checkpoints persist for the next incarnation
+    assert ckpt_mod.list_checkpoints(os.path.join(str(tmp_path),
+                                                  "ckpt"))
+    assert os.path.exists(os.path.join(str(tmp_path), "spill",
+                                       "manifest.json"))
+
+
+def test_clean_loop_promotes_every_cycle(tmp_path):
+    """An empty chaos schedule: every refresh promotes, zero
+    rollbacks, and each published version supersedes the last."""
+    os.environ.setdefault("LIGHTGBM_TPU_WATCH_REFRESH_P99_MS", "5000")
+    ctl, rep = _run(str(tmp_path), cycles=3, schedule={},
+                    use_gateway=False)
+    assert rep["ok"], rep["problems"]
+    assert rep["refresh_rollbacks"] == 0
+    outcomes = [c["outcome"] for c in rep["cycles"]]
+    assert outcomes == ["bootstrap", "promoted", "promoted"]
+    versions = [c["stable_version"] for c in rep["cycles"]]
+    assert versions == sorted(versions) and len(set(versions)) == 3
+    # each refresh cycle grew the forest by extra_rounds trees and
+    # the refit left the final model loadable from its own text
+    assert rep["cycles"][-1]["rounds"] == 2 + 1 * 2
+
+
+@pytest.mark.slow
+def test_full_schedule_loop(tmp_path):
+    """Four cycles through the full rotation: poisoned publish,
+    retryable train fault, telemetry push fault — every fault fires,
+    exactly one rollback, every other cycle promotes."""
+    os.environ.setdefault("LIGHTGBM_TPU_WATCH_REFRESH_P99_MS", "5000")
+    ctl, rep = _run(str(tmp_path), cycles=4)
+    assert rep["ok"], rep["problems"]
+    assert rep["refresh_rollbacks"] == 1
+    assert rep["faults_injected"] >= 3
+    assert [c["outcome"] for c in rep["cycles"]] == \
+        ["bootstrap", "rolled_back", "promoted", "promoted"]
+    for c in rep["cycles"][1:]:
+        assert c["injected"] >= 1, c
+
+
+@pytest.mark.slow
+def test_loop_survives_serve_admit_leg(tmp_path):
+    """A serve_admit injection during a clean publish window: exactly
+    one traffic request fails TYPED, the cycle still promotes."""
+    os.environ.setdefault("LIGHTGBM_TPU_WATCH_REFRESH_P99_MS", "5000")
+    sched = {1: [ChaosLeg("serve_admit:nth:1", "publish", False)]}
+    ctl, rep = _run(str(tmp_path), cycles=2, schedule=sched)
+    typed = rep["traffic"]["typed"]
+    assert sum(typed.values()) == 1, typed
+    assert rep["cycles"][1]["outcome"] == "promoted"
+    assert not rep["traffic"]["untyped"]
+    assert rep["stranded_futures"] == 0
+
+
+def test_publish_checkpoint_interleave(tmp_path):
+    """Canary rollback while the checkpoint machinery is mid-run, both
+    failure sites pinned: the checkpoint finalize fault is retried (the
+    dir stays valid and resumable), the canary fault rolls back (the
+    registry keeps serving v1), and neither plane corrupts the other."""
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(900, kFeatures))
+    y = (X[:, 0] > 0).astype(np.float64)
+    ckdir = str(tmp_path / "ck")
+    obs_registry.enable()
+    rb0 = obs_registry.count("serve/rollbacks")
+
+    reg = ModelRegistry()
+    base = lgb.train(dict(kParams), lgb.Dataset(X, label=y),
+                     num_boost_round=2)
+    v1 = reg.load("m", booster=base)
+    srv = PredictServer(reg, name="m", max_batch=64, max_wait_ms=2)
+    blk = np.ascontiguousarray(X[:32], dtype=np.float32)
+    srv.predict(blk, timeout=60)
+    outcomes = {}
+
+    def mid_train_publish(env):
+        # iteration 2 of the checkpointed run: publish a canary into
+        # the live server and let the armed dispatch fault kill it
+        if env.iteration == 1 and "published" not in outcomes:
+            outcomes["published"] = True
+            reg.load("m", booster=base, canary_batches=2)
+            outcomes["replayed"] = np.asarray(
+                srv.predict(blk, timeout=60))
+
+    faults.configure("checkpoint_finalize:nth:1;serve_dispatch:nth:1")
+    try:
+        trained = lgb.train(dict(kParams), lgb.Dataset(X, label=y),
+                            num_boost_round=4, checkpoint_dir=ckdir,
+                            checkpoint_freq=1,
+                            callbacks=[mid_train_publish])
+    finally:
+        faults.reset()
+    srv.stop()
+
+    # serving plane: rolled back, v1 still the stable version, and the
+    # poisoned batch was answered by v1's replay
+    assert obs_registry.count("serve/rollbacks") - rb0 == 1
+    assert reg.get("m")[0] == v1
+    assert not reg.canary_active("m")
+    host_ref = np.asarray(base.predict(blk, predict_on_device=False))
+    np.testing.assert_array_equal(outcomes["replayed"], host_ref)
+
+    # checkpoint plane: every iteration checkpointed through the
+    # retried finalize; the newest one resumes bit-identically
+    assert len(ckpt_mod.list_checkpoints(ckdir)) >= 1
+    resumed = lgb.train(dict(kParams), lgb.Dataset(X, label=y),
+                        num_boost_round=4, checkpoint_dir=ckdir,
+                        resume=True)
+    assert (resumed.inner.save_model_to_string()
+            == trained.inner.save_model_to_string())
+
+
+def test_traffic_generator_pause_quiesces(tmp_path):
+    """pause() returns only once every pump is parked with no request
+    in flight; resume() restarts the load."""
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(600, kFeatures))
+    y = (X[:, 0] > 0).astype(np.float64)
+    bst = lgb.train(dict(kParams), lgb.Dataset(X, label=y),
+                    num_boost_round=2)
+    from lightgbm_tpu.loop import TrafficGenerator
+    srv = PredictServer(bst, max_batch=64, max_wait_ms=1)
+    blk = np.ascontiguousarray(X[:16], dtype=np.float32)
+    srv.predict(blk, timeout=60)
+    gen = TrafficGenerator(srv, blk, threads=2, timeout_s=60)
+    gen.start()
+    deadline = threading.Event()
+    deadline.wait(0.2)
+    assert gen.pause(timeout_s=30)
+    n_paused = gen.stats()["requests"]
+    deadline.wait(0.1)
+    assert gen.stats()["requests"] == n_paused   # truly idle
+    gen.resume()
+    deadline.wait(0.3)
+    stats = gen.stop()
+    srv.stop()
+    assert stats["requests"] > n_paused
+    assert not stats["untyped"]
+
+
+def test_controller_rejects_degenerate_loop(tmp_path):
+    ctl = RefreshController(kParams, _data_fn,
+                            num_features=kFeatures,
+                            work_dir=str(tmp_path))
+    with pytest.raises(ValueError):
+        ctl.run(cycles=1)

@@ -376,3 +376,91 @@ class TestStreamingSpill:
         sd.push_rows(X, label=y, group=[100, 300])
         with pytest.raises(LightGBMError):
             sd.finalize(spill_dir=str(tmp_path))
+
+
+class TestAttach:
+    """ShardedBinnedDataset.attach: reopen a spill dir without the
+    source data and without re-binning."""
+
+    def _spill(self, tmp_path, n=1000, w=None):
+        X, y = _data(n)
+        cfg = Config.from_params(dict(BASE))
+        ds = ShardedBinnedDataset.from_chunk_source(
+            _source(X, y, w=w), cfg, str(tmp_path / "sp"),
+            shard_rows=n // 3, total_rows=n)
+        return X, y, ds
+
+    def test_attached_training_bit_identical(self, tmp_path):
+        _, _, ds = self._spill(tmp_path)
+        b_orig = _train(ds, BASE)
+        att = ShardedBinnedDataset.attach(
+            str(tmp_path / "sp"), config=Config.from_params(dict(BASE)))
+        assert att.num_data == ds.num_data
+        assert [m.feature_info() for m in att.bin_mappers] == \
+            [m.feature_info() for m in ds.bin_mappers]
+        np.testing.assert_array_equal(att.metadata.label,
+                                      ds.metadata.label)
+        b_att = _train(att, BASE)
+        assert (b_att.save_model_to_string()
+                == b_orig.save_model_to_string())
+
+    def test_attach_restores_weights(self, tmp_path):
+        n = 900
+        rng = np.random.RandomState(9)
+        w = rng.uniform(0.5, 2.0, size=n)
+        _, _, ds = self._spill(tmp_path, n=n, w=w)
+        att = ShardedBinnedDataset.attach(
+            str(tmp_path / "sp"), config=Config.from_params(dict(BASE)))
+        assert att.has_weights
+        np.testing.assert_allclose(att.metadata.weights,
+                                   w.astype(np.float32))
+
+    def test_attach_refuses_mapperless_manifest(self, tmp_path):
+        from lightgbm_tpu.utils.log import LightGBMError
+        self._spill(tmp_path)
+        mpath = tmp_path / "sp" / "manifest.json"
+        m = json.loads(mpath.read_text())
+        del m["mappers"]
+        mpath.write_text(json.dumps(m))
+        with pytest.raises(LightGBMError, match="mapper"):
+            ShardedBinnedDataset.attach(str(tmp_path / "sp"))
+
+    def test_attach_refuses_degraded_spill(self, tmp_path):
+        from lightgbm_tpu.utils.log import LightGBMError
+        self._spill(tmp_path)
+        mpath = tmp_path / "sp" / "manifest.json"
+        m = json.loads(mpath.read_text())
+        m["resident_shards"] = [1]
+        mpath.write_text(json.dumps(m))
+        with pytest.raises(LightGBMError, match="degraded"):
+            ShardedBinnedDataset.attach(str(tmp_path / "sp"))
+
+    def test_attach_refuses_truncated_shard(self, tmp_path):
+        from lightgbm_tpu.utils.log import LightGBMError
+        self._spill(tmp_path)
+        mpath = tmp_path / "sp" / "manifest.json"
+        name = sorted(json.loads(mpath.read_text())["files"])[0]
+        path = tmp_path / "sp" / name
+        path.write_bytes(path.read_bytes()[:-8])
+        with pytest.raises(LightGBMError, match="truncated"):
+            ShardedBinnedDataset.attach(str(tmp_path / "sp"))
+
+    def test_attach_refuses_missing_manifest(self, tmp_path):
+        from lightgbm_tpu.utils.log import LightGBMError
+        (tmp_path / "empty").mkdir()
+        with pytest.raises(LightGBMError, match="manifest"):
+            ShardedBinnedDataset.attach(str(tmp_path / "empty"))
+
+    def test_mapper_dict_roundtrip_preserves_bins(self, tmp_path):
+        from lightgbm_tpu.io.binning import BinMapper
+        _, _, ds = self._spill(tmp_path)
+        for m in ds.bin_mappers:
+            m2 = BinMapper.from_dict(m.to_dict())
+            assert m2.num_bin == m.num_bin
+            assert m2.bin_type == m.bin_type
+            assert m2.missing_type == m.missing_type
+            np.testing.assert_array_equal(
+                np.asarray(m2.bin_upper_bound),
+                np.asarray(m.bin_upper_bound))
+            assert m2.categorical_2_bin == m.categorical_2_bin
+            assert m2.most_freq_bin == m.most_freq_bin

@@ -56,4 +56,45 @@ assert parse_openmetrics(render_openmetrics(reg)) == parsed
 print("round-trip ok (%d samples)" % len(parsed))
 EOF
 
+echo "== refresh-loop smoke (2 cycles, poisoned canary) =="
+# Bounded closed-loop pass: bootstrap + one POISONED refresh under live
+# traffic. Nonzero exit on a stranded future, an SLO breach, a missed
+# rollback, or a lost fault (report['ok'] covers the whole contract).
+LIGHTGBM_TPU_WATCH_REFRESH_P99_MS="${LIGHTGBM_TPU_WATCH_REFRESH_P99_MS:-5000}" \
+python - <<'EOF'
+import tempfile
+
+import numpy as np
+
+from lightgbm_tpu.loop import RefreshController
+
+kF = 10
+
+
+def data_fn(cycle):
+    rng = np.random.default_rng(70 + cycle)
+    X = rng.normal(size=(800, kF))
+    return X, (X[:, 0] + 0.5 * X[:, 1] > 0.2).astype(np.float64)
+
+
+params = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+          "verbosity": -1, "min_data_in_leaf": 10,
+          "bin_construct_sample_cnt": 800}
+with tempfile.TemporaryDirectory(prefix="lgbm_tpu_refresh_") as work:
+    ctl = RefreshController(params, data_fn, num_features=kF,
+                            work_dir=work, base_rounds=2,
+                            extra_rounds=1, traffic_threads=2,
+                            traffic_rows=32, drain_timeout_s=15)
+    rep = ctl.run(cycles=2)
+assert rep["ok"], "refresh loop violated its contract: %s" \
+    % rep["problems"]
+assert rep["refresh_rollbacks"] == rep["expected_rollbacks"] == 1
+assert rep["stranded_futures"] == 0
+assert rep["refresh_slo_breaches"] == 0
+print("refresh loop ok (%d cycles, %.1fs/refresh, p99 %.1f ms, "
+      "%d rollback, 0 stranded)"
+      % (rep["num_cycles"], rep["refresh_cycle_seconds"],
+         rep["serve_p99_during_refresh_ms"], rep["refresh_rollbacks"]))
+EOF
+
 echo "CHECK OK"
