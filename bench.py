@@ -1013,10 +1013,23 @@ def run_refresh_bench() -> dict:
     Exit nonzero on any SLO breach, lost fault, stranded future, or a
     cycle that ended in the wrong outcome.
 
+    The stage runs TWICE: a no-shift CONTROL loop first (cadence
+    trigger, clean traffic — the quality plane must stay quiet: any
+    drift-rule firing or PSI above threshold is a false positive and
+    fails the stage), then the main loop with ``refresh_trigger=
+    "drift"`` and the TrafficGenerator's mid-run covariate shift
+    injected — the shift must be detected (``drift_psi_max`` over
+    threshold, the ``feature_drift`` watchdog rule fired, and at least
+    one drift-gated refresh cycle started on the breach). Drift keys:
+    ``drift_psi_max``, ``drift_detect_windows`` (windows drained until
+    the first breach), ``drift_triggered_refreshes``.
+
     Env knobs: BENCH_REFRESH_ROWS (20k per window),
     BENCH_REFRESH_CYCLES (4 = bootstrap + 3 refreshes),
     BENCH_REFRESH_BASE_ROUNDS (6), BENCH_REFRESH_EXTRA_ROUNDS (2),
     BENCH_REFRESH_THREADS (2 traffic pumps),
+    BENCH_REFRESH_SHIFT_ROWS (2048 — served rows before the covariate
+    shift kicks in), BENCH_REFRESH_CONTROL_CYCLES (3),
     LIGHTGBM_TPU_WATCH_REFRESH_P99_MS (serve p99 SLO; the bench
     defaults it to 1000 ms because the CI box shares its cores between
     the resumed training step and the serving plane — re-tighten on a
@@ -1046,36 +1059,122 @@ def run_refresh_bench() -> dict:
               "verbosity": -1, "min_data_in_leaf": 20,
               "bin_construct_sample_cnt": 20_000}
 
+    shift_rows = int(os.environ.get("BENCH_REFRESH_SHIFT_ROWS", 2048))
+    control_cycles = int(os.environ.get("BENCH_REFRESH_CONTROL_CYCLES",
+                                        3))
+    psi_thr = float(os.environ.get("LIGHTGBM_TPU_WATCH_PSI", "0.25"))
+    # a drift window must hold enough DISTINCT rows that an unshifted
+    # stream's sampling noise (expected PSI ~ bins/rows) stays well
+    # under the threshold: 64 pool blocks x 64 rows = 4096 distinct
+    # rows over <=255 bins -> noise floor ~0.06 against a 0.25 cut
+    drift_kw = dict(traffic_rows=64, traffic_pool=64,
+                    drift_min_window_rows=4096, drift_window_s=1.0,
+                    drift_max_windows=6)
+
     def data_fn(cycle):
         return make_higgs_like(rows, n_feat, seed=7 + cycle)
 
-    work = tempfile.mkdtemp(prefix="lgbm_tpu_refresh_")
+    # the control must be STATIONARY end to end: per-seed windows of
+    # make_higgs_like genuinely move the class balance (real label
+    # drift, which the main run is allowed to detect), so the control
+    # slices its windows out of ONE draw instead
+    control_cycles = min(control_cycles, cycles)
+    Xc, yc = make_higgs_like(rows * control_cycles, n_feat, seed=7)
+
+    def control_data_fn(cycle):
+        lo = cycle * rows
+        return Xc[lo:lo + rows], yc[lo:lo + rows]
+
+    def _drift_counts():
+        return {r: obs_registry.count("health/" + r)
+                for r in ("feature_drift", "prediction_drift",
+                          "label_drift", "retrain_required")}
+
     _stage("refresh_start", rows=rows, cycles=cycles,
-           base_rounds=base, extra_rounds=extra)
+           base_rounds=base, extra_rounds=extra, shift_rows=shift_rows)
+
+    # ---- no-shift control: the quality plane must stay quiet --------
+    c0 = _drift_counts()
+    work = tempfile.mkdtemp(prefix="lgbm_tpu_refresh_ctl_")
+    try:
+        ctl = RefreshController(params, control_data_fn,
+                                num_features=n_feat,
+                                work_dir=work, base_rounds=base,
+                                extra_rounds=extra,
+                                traffic_threads=threads,
+                                schedule={}, **drift_kw)
+        control = ctl.run(cycles=control_cycles)
+    finally:
+        if not os.environ.get("BENCH_REFRESH_KEEP"):
+            shutil.rmtree(work, ignore_errors=True)
+    control_fired = {r: obs_registry.count("health/" + r) - v
+                     for r, v in c0.items() if
+                     obs_registry.count("health/" + r) - v > 0}
+    _stage("refresh_control", ok=control["ok"],
+           drift_psi_max=control["drift_psi_max"],
+           drift_windows=control["drift_windows"],
+           false_positives=str(control_fired))
+
+    # ---- main loop: drift-gated refresh under injected shift --------
+    c0 = _drift_counts()
+    work = tempfile.mkdtemp(prefix="lgbm_tpu_refresh_")
     try:
         ctl = RefreshController(params, data_fn, num_features=n_feat,
                                 work_dir=work, base_rounds=base,
                                 extra_rounds=extra,
-                                traffic_threads=threads)
+                                traffic_threads=threads,
+                                refresh_trigger="drift",
+                                shift_after_rows=shift_rows,
+                                **drift_kw)
         report = ctl.run(cycles=cycles)
     finally:
         if not os.environ.get("BENCH_REFRESH_KEEP"):
             shutil.rmtree(work, ignore_errors=True)
+    drift_fired = obs_registry.count("health/feature_drift") \
+        - c0["feature_drift"]
+
+    problems = list(report["problems"])
+    if control["drift_psi_max"] >= psi_thr:
+        problems.append(
+            "control false positive: PSI %.3f >= %.2f on an unshifted "
+            "stream" % (control["drift_psi_max"], psi_thr))
+    if control_fired:
+        problems.append("control false positive: drift rules fired %s"
+                        % control_fired)
+    if not control["ok"]:
+        problems.append("control loop not ok: %s"
+                        % "; ".join(control["problems"]))
+    if report["drift_psi_max"] < psi_thr:
+        problems.append(
+            "injected covariate shift UNDETECTED: drift_psi_max %.3f "
+            "< %.2f" % (report["drift_psi_max"], psi_thr))
+    if report["drift_triggered_refreshes"] < 1:
+        problems.append("injected shift never triggered a drift-gated "
+                        "refresh cycle")
+    if drift_fired < 1:
+        problems.append("feature_drift watchdog rule never fired "
+                        "under injected shift")
+    ok = not problems
+
     for rec in report["cycles"]:
         _stage("refresh_cycle", **rec)
-    _stage("refresh_done", ok=report["ok"],
+    _stage("refresh_done", ok=ok,
            rollbacks=report["refresh_rollbacks"],
            slo_breaches=report["refresh_slo_breaches"],
            stranded=report["stranded_futures"],
            faults_injected=report["faults_injected"],
            traffic_requests=report["traffic"].get("requests", 0),
-           problems="; ".join(report["problems"]))
+           drift_psi_max=report["drift_psi_max"],
+           drift_triggered=report["drift_triggered_refreshes"],
+           problems="; ".join(problems))
     return {
         "metric": "refresh_cycle_seconds",
         "value": report["refresh_cycle_seconds"],
         "unit": "s/refresh-cycle on %s (%d cycles; p99 %.1f ms under "
                 "%d traffic pumps; %d/%d scheduled rollbacks; %d SLO "
-                "breaches; %d stranded; %d faults injected%s)"
+                "breaches; %d stranded; %d faults injected; drift PSI "
+                "%.2f detected in %s windows, %d drift-gated "
+                "refreshes, control PSI %.2f%s)"
                 % (platform, report["num_cycles"],
                    report["serve_p99_during_refresh_ms"], threads,
                    report["refresh_rollbacks"],
@@ -1083,8 +1182,11 @@ def run_refresh_bench() -> dict:
                    report["refresh_slo_breaches"],
                    report["stranded_futures"],
                    report["faults_injected"],
-                   "" if report["ok"] else "; PROBLEMS: "
-                   + "; ".join(report["problems"])),
+                   report["drift_psi_max"],
+                   report["drift_detect_windows"],
+                   report["drift_triggered_refreshes"],
+                   control["drift_psi_max"],
+                   "" if ok else "; PROBLEMS: " + "; ".join(problems)),
         "backend": platform,
         "refresh_cycle_seconds": report["refresh_cycle_seconds"],
         "serve_p99_during_refresh_ms":
@@ -1093,7 +1195,13 @@ def run_refresh_bench() -> dict:
         "refresh_rollbacks": report["refresh_rollbacks"],
         "refresh_stranded_futures": report["stranded_futures"],
         "refresh_faults_injected": report["faults_injected"],
-        "refresh_ok": bool(report["ok"]),
+        "drift_psi_max": report["drift_psi_max"],
+        "drift_detect_windows": report["drift_detect_windows"],
+        "drift_triggered_refreshes":
+            report["drift_triggered_refreshes"],
+        "drift_control_psi_max": control["drift_psi_max"],
+        "drift_control_false_positives": control_fired,
+        "refresh_ok": bool(ok),
     }
 
 

@@ -267,6 +267,10 @@ class GBDT:
         K = self.num_tree_per_iteration
         self._has_init_score = train_data.metadata.init_score is not None
         self.train_score = jnp.asarray(self._initial_score())
+        # training-grid drift baseline (obs/quality.py); set by the
+        # engine from a spilled dataset or by a checkpoint resume, and
+        # persisted by ft/checkpoint.save alongside the model
+        self.quality_profile = None
 
         self.class_need_train = [True] * K
         if self.objective is not None:

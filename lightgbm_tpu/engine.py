@@ -70,6 +70,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
     train_set.construct()
 
     booster = Booster(params=params, train_set=train_set)
+    # quality plane: a sharded/spilled dataset carries its training-grid
+    # reference profile (obs/quality.py); hand it to the booster so the
+    # checkpoint writer persists it (a checkpoint resume below may
+    # override with the profile stored alongside the model)
+    spill_profile = getattr(booster.inner.train_data,
+                            "quality_profile", None)
+    if spill_profile is not None:
+        booster.inner.quality_profile = spill_profile
     if init_model is not None:
         init_str = (init_model.model_to_string()
                     if isinstance(init_model, Booster)

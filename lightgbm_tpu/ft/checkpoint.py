@@ -319,6 +319,22 @@ def save(gbdt, directory: str, keep: Optional[int] = None) -> str:
         # the device exactly once per checkpoint interval, never per
         # iteration (the transfer-guard test pins the iteration clean)
         score = np.asarray(gbdt.train_score, dtype=np.float32)
+        # data/model-quality baseline (obs/quality.py): when the
+        # booster carries a training-grid reference profile, stamp the
+        # prediction-score histogram from the same train_score read and
+        # persist the whole profile next to the required files (extra,
+        # optional — REQUIRED_FILES is unchanged, old checkpoints load)
+        profile_json = None
+        profile = getattr(gbdt, "quality_profile", None)
+        if profile is not None:
+            try:
+                profile.attach_scores(
+                    score, objective=getattr(gbdt, "objective", None))
+                profile_json = json.dumps(profile.to_dict()).encode()
+            except Exception as e:  # noqa: BLE001 — a profile bug must
+                #                     not void the checkpoint
+                log.warning("checkpoint: quality profile not captured "
+                            "(%r)" % (e,))
 
         tmp = os.path.join(directory, "%s%08d-%d"
                            % (TMP_PREFIX, gbdt.iter, os.getpid()))
@@ -333,6 +349,8 @@ def save(gbdt, directory: str, keep: Optional[int] = None) -> str:
             if bag is not None:
                 np.save(os.path.join(tmp, "bag.npy"), bag)
                 _fsync_file(os.path.join(tmp, "bag.npy"))
+            if profile_json is not None:
+                _write_file(tmp, "quality_profile.json", profile_json)
             _write_file(tmp, "state.json",
                         json.dumps(state, indent=1).encode())
             files = {}
@@ -570,6 +588,21 @@ def load_latest(gbdt, directory: str) -> Optional[dict]:
                                               K))
             gbdt.train_score = jnp.asarray(score)
             gbdt._train_bins_dev = None
+
+            # reload the quality baseline when the checkpoint carries
+            # one (optional file; pre-quality-plane checkpoints simply
+            # resume without a drift reference)
+            qp_path = os.path.join(path, "quality_profile.json")
+            if os.path.exists(qp_path):
+                from ..obs import quality as obs_quality
+                try:
+                    gbdt.quality_profile = \
+                        obs_quality.ReferenceProfile.load(qp_path)
+                except (OSError, KeyError, TypeError, ValueError) as e:
+                    log.warning_always("checkpoint %s: unreadable "
+                                       "quality_profile.json (%r); "
+                                       "resuming without a drift "
+                                       "baseline" % (path, e))
 
             _restore_strategy(gbdt, state, path)
             _restore_learner(gbdt, state)

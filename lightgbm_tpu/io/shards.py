@@ -62,6 +62,7 @@ import numpy as np
 from ..config import Config
 from ..obs import events as obs_events
 from ..obs import faults
+from ..obs import quality as obs_quality
 from ..obs.registry import registry as obs
 from ..utils import log
 from ..utils.atomic import atomic_write, sha256_file
@@ -232,6 +233,9 @@ class ShardedBinnedDataset:
         # every reopen: size per open, full content hash on the first
         self._file_meta: Dict[str, dict] = {}
         self._verified_shards: set = set()
+        # training-grid reference profile (obs/quality.py) captured at
+        # spill time; None on spills written before the quality plane
+        self.quality_profile = None
 
     # ------------------------------------------------------------------
     @classmethod
@@ -326,6 +330,8 @@ class ShardedBinnedDataset:
         except ValueError:
             resident_budget_mb = 512.0
         degraded = False
+        profiler = obs_quality.ProfileBuilder(
+            self.bin_mappers, self.used_feature_map, self.feature_names)
 
         def _cleanup_partial(k: int) -> None:
             for p in (self._bins_path(k), self._label_path(k),
@@ -402,8 +408,13 @@ class ShardedBinnedDataset:
                 obs.inc("io/shards_spilled")
             if any_label:
                 labels.append(lbuf[:fill].copy())
+                profiler.add_labels(lbuf[:fill])
             if any_weight:
                 weights.append(wbuf[:fill].copy())
+            # reference-profile capture rides the spill: one jitted
+            # device reduction over the shard buffer already binned
+            # above (fixed shape -> one trace for the whole spill)
+            profiler.add_block(buf, fill)
             self.shard_sizes.append(fill)
             shard_no += 1
             fill = 0
@@ -453,6 +464,7 @@ class ShardedBinnedDataset:
             self.metadata.set_label(np.concatenate(labels))
         if any_weight:
             self.metadata.set_weights(np.concatenate(weights))
+        self.quality_profile = profiler.finalize()
         manifest = {
             "num_data": n,
             "num_features_used": F_used,
@@ -470,6 +482,10 @@ class ShardedBinnedDataset:
             "feature_names": self.feature_names,
             "used_feature_map": self.used_feature_map,
             "mappers": [m.to_dict() for m in self.bin_mappers],
+            # training-grid reference profile (obs/quality.py): the
+            # drift baseline reloads with the spill, no source data
+            # needed
+            "quality_profile": self.quality_profile.to_dict(),
         }
         try:
             atomic_write(os.path.join(self.spill_dir, "manifest.json"),
@@ -561,6 +577,16 @@ class ShardedBinnedDataset:
             np.concatenate([[0], np.cumsum(self.shard_sizes)[:-1]])
             .astype(int))
         self.has_weights = bool(manifest["has_weight"])
+        # drift baseline: absent on spills written before the quality
+        # plane (tolerated — drift monitoring is then simply off); a
+        # malformed one is rejected loudly like any other manifest rot
+        if manifest.get("quality_profile") is not None:
+            try:
+                self.quality_profile = obs_quality.ReferenceProfile \
+                    .from_dict(manifest["quality_profile"])
+            except (KeyError, TypeError, ValueError) as e:
+                log.fatal("spill manifest under %s carries a malformed "
+                          "quality_profile: %r" % (self.spill_dir, e))
         self._file_meta = {str(k): dict(v)
                            for k, v in manifest["files"].items()}
         # every manifest-listed file must exist at its recorded size

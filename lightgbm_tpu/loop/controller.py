@@ -53,6 +53,17 @@ from ..utils import log
 from . import chaos as chaos_mod
 
 
+def covariate_shift(block: np.ndarray) -> np.ndarray:
+    """Default mid-run covariate-shift transform for
+    :class:`TrafficGenerator`: translate every feature by 2.5 of its
+    own std (plus a floor for constant columns). The shape and dtype —
+    and therefore the serving bucket and trace count — stay identical
+    to the unshifted block; only the bin occupancy moves, which is
+    exactly what the quality plane's PSI must catch."""
+    s = block.std(axis=0, keepdims=True)
+    return (block + 2.5 * s + 0.5).astype(block.dtype)
+
+
 class TrafficGenerator:
     """Sustained synthetic serving load: ``threads`` daemon threads pump
     one block each through ``server.predict`` in a tight loop, counting
@@ -63,22 +74,80 @@ class TrafficGenerator:
     canary's deterministically, which live pumps can't guarantee. Each
     pump is synchronous (``predict`` blocks on its own Future), so once
     every thread reports idle there are zero generator requests in
-    flight."""
+    flight.
 
-    def __init__(self, server: PredictServer, block: np.ndarray,
-                 threads: int = 2, timeout_s: float = 120.0) -> None:
+    ``block`` may be a single array or a LIST of equal-shape arrays (a
+    pool): the pumps round-robin through the pool, so a drift window
+    sees pool_size x block_rows DISTINCT rows instead of one block
+    repeated — with a single small block, an identical-distribution
+    window still scores PSI ~ bins/distinct_rows of pure sampling
+    noise. Equal shapes keep the whole pool in one warmed serve bucket.
+
+    ``shift_after_rows=N`` injects covariate shift mid-run: once the
+    pumps have collectively answered N rows, every subsequent request
+    uses ``shift_fn(block)`` (default :func:`covariate_shift`) instead
+    of the original pool. The shifted blocks keep the original shape
+    and dtype, so the swap is invisible to the compile cache — the only
+    observable difference is the input distribution, which is the
+    quality plane's job to notice."""
+
+    def __init__(self, server: PredictServer, block,
+                 threads: int = 2, timeout_s: float = 120.0,
+                 shift_after_rows: Optional[int] = None,
+                 shift_fn: Optional[Callable] = None) -> None:
         self.server = server
-        self.block = block
+        pool = list(block) if isinstance(block, (list, tuple)) \
+            else [block]
+        if not pool:
+            raise ValueError("need at least one traffic block")
+        if any(b.shape != pool[0].shape for b in pool):
+            raise ValueError("pool blocks must share one shape (one "
+                             "warmed serve bucket)")
+        self.pool: List[np.ndarray] = pool
+        self.block = pool[0]
         self.timeout_s = float(timeout_s)
         self.n_threads = max(int(threads), 1)
+        self.shift_after_rows = (None if shift_after_rows is None
+                                 else int(shift_after_rows))
+        self._shift_pool: Optional[List[np.ndarray]] = None
+        if self.shift_after_rows is not None:
+            fn = shift_fn if shift_fn is not None else covariate_shift
+            self._shift_pool = []
+            for b in pool:
+                shifted = np.ascontiguousarray(
+                    fn(np.array(b, copy=True)), dtype=b.dtype)
+                if shifted.shape != b.shape:
+                    raise ValueError(
+                        "shift_fn changed the block shape %s -> %s; "
+                        "the shifted block must reuse the warmed "
+                        "bucket" % (b.shape, shifted.shape))
+                self._shift_pool.append(shifted)
+        self._shifted = threading.Event()
         self._stop = threading.Event()
         self._pause = threading.Event()
         self._idle = [threading.Event() for _ in range(self.n_threads)]
         self._threads: List[threading.Thread] = []
         # per-thread stats, merged at read time (no locks on the pump)
-        self._stats = [{"requests": 0, "rows_ok": 0, "shed": 0,
-                        "typed": {}, "untyped": []}
+        self._stats = [{"requests": 0, "rows_ok": 0, "rows_shifted": 0,
+                        "shed": 0, "typed": {}, "untyped": []}
                        for _ in range(self.n_threads)]
+
+    def _current_block(self, t: int, seq: int):
+        shifted = False
+        if self._shift_pool is not None:
+            if self._shifted.is_set():
+                shifted = True
+            else:
+                # cross-thread dict reads are GIL-atomic; an
+                # off-by-a-block trigger point is fine, a lock on the
+                # pump path is not
+                total = sum(s["rows_ok"] for s in self._stats)
+                if total >= self.shift_after_rows:
+                    self._shifted.set()
+                    shifted = True
+        pool = self._shift_pool if shifted else self.pool
+        # stride the threads so N pumps don't walk the pool in lockstep
+        return pool[(seq * self.n_threads + t) % len(pool)], shifted
 
     def _pump(self, t: int) -> None:
         st = self._stats[t]
@@ -89,9 +158,12 @@ class TrafficGenerator:
                 continue
             self._idle[t].clear()
             st["requests"] += 1
+            blk, shifted = self._current_block(t, st["requests"])
             try:
-                self.server.predict(self.block, timeout=self.timeout_s)
-                st["rows_ok"] += self.block.shape[0]
+                self.server.predict(blk, timeout=self.timeout_s)
+                st["rows_ok"] += blk.shape[0]
+                if shifted:
+                    st["rows_shifted"] += blk.shape[0]
             except Overloaded:
                 st["shed"] += 1
             except (ServeError, faults.InjectedFault) as e:
@@ -126,11 +198,12 @@ class TrafficGenerator:
         self._pause.clear()
 
     def stats(self) -> Dict:
-        out = {"requests": 0, "rows_ok": 0, "shed": 0,
-               "typed": {}, "untyped": []}
+        out = {"requests": 0, "rows_ok": 0, "rows_shifted": 0,
+               "shed": 0, "typed": {}, "untyped": []}
         for st in self._stats:
             out["requests"] += st["requests"]
             out["rows_ok"] += st["rows_ok"]
+            out["rows_shifted"] += st["rows_shifted"]
             out["shed"] += st["shed"]
             for k, v in st["typed"].items():
                 out["typed"][k] = out["typed"].get(k, 0) + v
@@ -166,7 +239,17 @@ class RefreshController:
                  shard_rows: Optional[int] = None,
                  drain_timeout_s: float = 30.0,
                  canary_timeout_s: float = 60.0,
-                 max_batch: int = 256, max_wait_ms: float = 2.0) -> None:
+                 max_batch: int = 256, max_wait_ms: float = 2.0,
+                 refresh_trigger: str = "cadence",
+                 drift_max_windows: int = 4,
+                 drift_window_s: float = 0.25,
+                 drift_min_window_rows: int = 0,
+                 traffic_pool: int = 1,
+                 shift_after_rows: Optional[int] = None,
+                 shift_fn: Optional[Callable] = None) -> None:
+        if refresh_trigger not in ("cadence", "drift"):
+            raise ValueError("refresh_trigger must be 'cadence' or "
+                             "'drift', got %r" % (refresh_trigger,))
         self.params = dict(params)
         self.data_fn = data_fn
         self.num_features = int(num_features)
@@ -187,6 +270,28 @@ class RefreshController:
         self.canary_timeout_s = float(canary_timeout_s)
         self.max_batch = int(max_batch)
         self.max_wait_ms = float(max_wait_ms)
+        # drift-gated refresh (refresh_trigger="drift"): before each
+        # refresh cycle the controller drains short drift windows until
+        # one breaches LIGHTGBM_TPU_WATCH_PSI, then refreshes early; a
+        # clean streak of drift_max_windows falls back to cadence so a
+        # refresh is never starved by a calm input stream
+        self.refresh_trigger = refresh_trigger
+        self.drift_max_windows = max(int(drift_max_windows), 1)
+        self.drift_window_s = float(drift_window_s)
+        self.drift_min_window_rows = int(drift_min_window_rows)
+        # traffic_pool > 1 pumps a rotating pool of traffic_pool
+        # equal-shape blocks instead of one block: a drift window then
+        # holds pool*rows DISTINCT rows, keeping sampling-noise PSI
+        # well under the drift threshold on an unshifted stream
+        self.traffic_pool = max(int(traffic_pool), 1)
+        self.shift_after_rows = shift_after_rows
+        self.shift_fn = shift_fn
+        self.quality = None
+        self.drift_psi_max = 0.0
+        self.drift_windows = 0
+        self.drift_triggered = 0
+        self.drift_detect_windows: Optional[int] = None
+        self._warned_no_quality = False
 
         self.registry = ModelRegistry()
         self.server: Optional[PredictServer] = None
@@ -247,14 +352,43 @@ class RefreshController:
                      checkpoint_dir=self.ckpt_dir,
                      checkpoint_freq=self.checkpoint_freq)
         version = self.registry.load(self.name, booster=bst)
+        profile = getattr(bst.inner, "quality_profile", None)
+        if profile is not None:
+            from ..obs import quality as obs_quality
+            if profile.score_hist is None:
+                # checkpointed runs attach scores at save time; a
+                # checkpoint-free loop attaches them here instead
+                profile.attach_scores(
+                    np.asarray(bst.inner.train_score, dtype=np.float32),
+                    objective=getattr(bst.inner, "objective", None))
+            _, forest = self.registry.get(self.name)
+            # the monitor pins the BASE model's quantizer grid: drift
+            # across later refresh publishes is measured on one fixed
+            # grid, never an artifact of a model swap
+            self.quality = obs_quality.QualityMonitor(
+                forest, profile=profile, name=self.name,
+                min_window_rows=self.drift_min_window_rows)
         self.server = PredictServer(self.registry, name=self.name,
                                     max_batch=self.max_batch,
-                                    max_wait_ms=self.max_wait_ms)
-        self._block = np.ascontiguousarray(X0[:self.traffic_rows],
-                                           dtype=np.float32)
+                                    max_wait_ms=self.max_wait_ms,
+                                    quality=self.quality)
+        pool = []
+        for i in range(self.traffic_pool):
+            blk = X0[i * self.traffic_rows:(i + 1) * self.traffic_rows]
+            if blk.shape[0] < self.traffic_rows:
+                break
+            pool.append(np.ascontiguousarray(blk, dtype=np.float32))
+        if not pool:  # window smaller than one block: pump what exists
+            pool = [np.ascontiguousarray(X0[:self.traffic_rows],
+                                         dtype=np.float32)]
+        self._block = pool[0]
         self.server.predict(self._block, timeout=120)  # warm the bucket
-        self.traffic = TrafficGenerator(self.server, self._block,
-                                        threads=self.traffic_threads)
+        if self.quality is not None:
+            self.quality.drain(obs_registry)  # warm rows != window 0
+        self.traffic = TrafficGenerator(
+            self.server, pool, threads=self.traffic_threads,
+            shift_after_rows=self.shift_after_rows,
+            shift_fn=self.shift_fn)
         self.traffic.start()
         seconds = time.perf_counter() - t0
         rec = {"cycle": 0, "outcome": "bootstrap", "version": version,
@@ -325,6 +459,10 @@ class RefreshController:
 
         # --- refit on the fresh window (pure device replay) ----------
         Xw, yw, ww = self._window(cycle)
+        if self.quality is not None:
+            # refresh windows carry labels; serve traffic does not —
+            # this is the label-drift signal's only source
+            self.quality.observe_labels(yw)
         bst.refit(Xw, yw, weight=ww)
         model_str = bst.model_to_string()
 
@@ -393,6 +531,50 @@ class RefreshController:
         return rec
 
     # ------------------------------------------------------------------
+    def _drift_gate(self, cycle: int, problems: List[str]) -> Dict:
+        """Gate one refresh cycle on observed serving-input drift.
+
+        ``refresh_trigger="drift"``: drain up to ``drift_max_windows``
+        short windows; the first whose per-feature PSI max breaches
+        ``LIGHTGBM_TPU_WATCH_PSI`` starts the cycle early (counted in
+        ``drift_triggered_refreshes``); a clean streak proceeds anyway
+        (cadence fallback). ``refresh_trigger="cadence"``: one window
+        still drains per cycle so the quality gauges — and the drift
+        watchdog rules — stay live, but nothing is gated on them."""
+        if self.quality is None:
+            if (self.refresh_trigger == "drift"
+                    and not self._warned_no_quality):
+                problems.append(
+                    "refresh_trigger='drift' but the spill carried no "
+                    "quality profile (written before the quality "
+                    "plane?) — cycles fall back to cadence")
+                self._warned_no_quality = True
+            return {}
+        thr = float(os.environ.get("LIGHTGBM_TPU_WATCH_PSI", "0.25"))
+        budget = (self.drift_max_windows
+                  if self.refresh_trigger == "drift" else 1)
+        psi_seen = 0.0
+        for w in range(1, budget + 1):
+            time.sleep(self.drift_window_s)
+            rep = self.quality.drain(obs_registry)
+            self.drift_windows += 1
+            psi = float(rep.get("psi_max", 0.0))
+            psi_seen = max(psi_seen, psi)
+            self.drift_psi_max = max(self.drift_psi_max, psi)
+            if (self.refresh_trigger == "drift"
+                    and rep.get("rows", 0) and psi >= thr):
+                self.drift_triggered += 1
+                if self.drift_detect_windows is None:
+                    self.drift_detect_windows = w
+                return {"drift_gate": "triggered", "drift_windows": w,
+                        "drift_psi": round(psi, 4)}
+        if self.refresh_trigger != "drift":
+            return {"drift_psi": round(psi_seen, 4)}
+        return {"drift_gate": "cadence_fallback",
+                "drift_windows": budget,
+                "drift_psi": round(psi_seen, 4)}
+
+    # ------------------------------------------------------------------
     def run(self, cycles: int) -> Dict:
         """Run ``cycles`` total cycles (cycle 0 bootstraps; each later
         cycle is a refresh) and return the loop report. The report's
@@ -425,8 +607,11 @@ class RefreshController:
         try:
             records.append(self._bootstrap())
             for cycle in range(1, cycles):
-                records.append(self._refresh_cycle(
-                    cycle, schedule.get(cycle, []), problems))
+                gate = self._drift_gate(cycle, problems)
+                rec = self._refresh_cycle(
+                    cycle, schedule.get(cycle, []), problems)
+                rec.update(gate)
+                records.append(rec)
         finally:
             traffic = self.traffic.stop() if self.traffic else {}
             if self.server is not None:
@@ -471,6 +656,11 @@ class RefreshController:
             "stranded_futures": int(stranded),
             "faults_injected": obs_registry.count("ft/faults_injected")
             - inj0,
+            "refresh_trigger": self.refresh_trigger,
+            "drift_psi_max": round(self.drift_psi_max, 4),
+            "drift_windows": int(self.drift_windows),
+            "drift_detect_windows": self.drift_detect_windows,
+            "drift_triggered_refreshes": int(self.drift_triggered),
             "traffic": traffic,
             "problems": problems,
             "ok": not problems,

@@ -61,23 +61,34 @@ _ENV_WATCHDOG = "LIGHTGBM_TPU_WATCHDOG"
 
 kDefaultIntervalS = 10.0
 
-_REPLICA_RE = re.compile(r"^(.*)/replica/(\d+)(?:/model/(.+))?$")
+_LABEL_RE = re.compile(
+    r"^(.*)/(replica|feature)/([^/]+)(?:/model/(.+))?$")
 
 
-def _split_replica(name: str):
-    """``serve/latency_ms/replica/3/model/m`` →
-    (``serve/latency_ms``, (("model", "m"), ("replica", "3"))): a
-    serving fleet's per-replica series render as ONE family with
-    ``replica`` (and ``model``) labels, so a single scrape target
-    covers all replicas of every server in the process (the
-    per-process /metrics gap from the ROADMAP)."""
-    m = _REPLICA_RE.match(name)
+def _split_labels(name: str):
+    """Generic ``<base>/<label>/<k>[/model/<m>]`` → labeled-family
+    folding, ONE code path for every labeled registry series:
+
+    - ``serve/latency_ms/replica/3/model/m`` →
+      (``serve/latency_ms``, (("model", "m"), ("replica", "3"))) — a
+      serving fleet's per-replica series render as ONE family, so a
+      single scrape target covers all replicas of every server in the
+      process (the per-process /metrics gap from the ROADMAP);
+    - ``quality/psi/feature/7`` →
+      (``quality/psi``, (("feature", "7"),)) — the drift plane's
+      per-feature scores render as one ``{feature=}``-labeled family.
+    """
+    m = _LABEL_RE.match(name)
     if m is None:
         return name, None
-    labels = [("replica", m.group(2))]
-    if m.group(3) is not None:
-        labels.append(("model", m.group(3)))
+    labels = [(m.group(2), m.group(3))]
+    if m.group(4) is not None:
+        labels.append(("model", m.group(4)))
     return m.group(1), tuple(sorted(labels))
+
+
+# PR 11 name kept alive for callers/tests of the replica folding
+_split_replica = _split_labels
 
 
 def render_openmetrics(reg=registry) -> str:
@@ -112,7 +123,7 @@ def render_openmetrics(reg=registry) -> str:
     # (the samples of a family must stay contiguous under one # TYPE)
     families: Dict[str, list] = {}
     for name, v in plain.items():
-        base, labels = _split_replica(name)
+        base, labels = _split_labels(name)
         families.setdefault(base, []).append((labels, v))
     for base in sorted(families):
         m = kPrefix + _san(base) + "_total"
@@ -128,6 +139,9 @@ def render_openmetrics(reg=registry) -> str:
 
     gauges = snap.get("gauges", {})
     compile_g: Dict[str, Dict[str, float]] = {}
+    # numeric gauges fold through the SAME labeled-family path as the
+    # counters/histograms (quality/psi/feature/<k> → {feature="k"})
+    gfams: Dict[str, list] = {}
     for name, v in sorted(gauges.items()):
         if name.startswith("compile/"):
             parts = name.split("/")
@@ -135,13 +149,17 @@ def render_openmetrics(reg=registry) -> str:
                 compile_g.setdefault(parts[2], {})[parts[1]] = v
                 continue
         if isinstance(v, (int, float)) and not isinstance(v, bool):
-            m = kPrefix + _san(name)
-            out.append("# TYPE %s gauge" % m)
-            out.append("%s %s" % (m, _fmt(v)))
+            base, labels = _split_labels(name)
+            gfams.setdefault(base, []).append((labels, v))
         else:
             m = kPrefix + _san(name) + "_info"
             out.append("# TYPE %s gauge" % m)
             out.append('%s{value="%s"} 1' % (m, _esc(v)))
+    for base in sorted(gfams):
+        m = kPrefix + _san(base)
+        out.append("# TYPE %s gauge" % m)
+        for labels, v in sorted(gfams[base], key=lambda lv: lv[0] or ()):
+            out.append("%s%s %s" % (m, _lbl(labels), _fmt(v)))
     for metric, by_fn in sorted(compile_g.items()):
         m = kPrefix + "compile_" + _san(metric)
         out.append("# TYPE %s gauge" % m)
@@ -150,7 +168,7 @@ def render_openmetrics(reg=registry) -> str:
 
     hfams: Dict[str, list] = {}
     for name, h in snap.get("hists", {}).items():
-        base, labels = _split_replica(name)
+        base, labels = _split_labels(name)
         hfams.setdefault(base, []).append((labels, h))
     for base in sorted(hfams):
         m = kPrefix + _san(base)
@@ -260,6 +278,14 @@ class SnapshotExporter:
                 pass
 
     def dump_now(self) -> None:
+        try:
+            # each exporter tick is one drift window: drain the
+            # registered quality monitors FIRST so the snapshot (and
+            # the watchdog pass over it) sees this window's scores
+            from . import quality as _quality
+            _quality.drain_all(self.reg)
+        except Exception:
+            pass
         try:
             snap = self.reg.snapshot()
             self.watchdog.evaluate(snap)

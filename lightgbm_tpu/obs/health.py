@@ -91,12 +91,17 @@ def _env_float(name: str, default: float) -> float:
 class WatchRule:
     """One threshold rule: ``check(snapshot, state)`` returns a detail
     dict while the condition holds, else None. ``state`` is a per-rule
-    dict the rule may use for counter deltas across snapshots."""
+    dict the rule may use for counter deltas across snapshots.
+    ``component`` names the subsystem whose signal the rule watches —
+    it rides on the emitted ``health`` event so a consumer can route a
+    breach without parsing the rule name."""
 
     def __init__(self, name: str,
-                 check: Callable[[dict, dict], Optional[dict]]) -> None:
+                 check: Callable[[dict, dict], Optional[dict]],
+                 component: str = "obs.health") -> None:
         self.name = name
         self.check = check
+        self.component = component
 
 
 def _counter_delta(snap: dict, state: dict, match, state_key: str,
@@ -347,6 +352,86 @@ def default_rules() -> List[WatchRule]:
                               % (p99, refresh_p99_thr)}
         return None
 
+    # ---- data/model quality rules (obs/quality.py gauges) ------------
+    psi_thr = _env_float("LIGHTGBM_TPU_WATCH_PSI", 0.25)
+    score_psi_thr = _env_float("LIGHTGBM_TPU_WATCH_SCORE_PSI", 0.25)
+    label_psi_thr = _env_float("LIGHTGBM_TPU_WATCH_LABEL_PSI", 0.25)
+    edge_thr = _env_float("LIGHTGBM_TPU_WATCH_EDGE_MASS", 0.10)
+    edge_windows = _env_float("LIGHTGBM_TPU_WATCH_EDGE_WINDOWS", 3)
+
+    def feature_drift(snap, state):
+        # level rule over the drained drift window: worst per-feature
+        # PSI at or above LIGHTGBM_TPU_WATCH_PSI (default 0.25, the
+        # classic "distribution has shifted" PSI rule of thumb); fires
+        # once per breach episode, re-arms when a window scores clean
+        gauges = snap.get("gauges", {})
+        v = float(gauges.get("quality/psi_max", 0.0))
+        if v < psi_thr:
+            return None
+        worst, worst_v = "?", -1.0
+        for k, g in gauges.items():
+            if k.startswith("quality/psi/feature/"):
+                try:
+                    g = float(g)
+                except (TypeError, ValueError):
+                    continue
+                if g > worst_v:
+                    worst, worst_v = k.rsplit("/", 1)[1], g
+        return {"value": round(v, 4), "threshold": psi_thr,
+                "feature": worst,
+                "detail": "serving-input drift: PSI %.3f on feature %s "
+                          "(threshold %.2f)" % (v, worst, psi_thr)}
+
+    def prediction_drift(snap, state):
+        v = float(snap.get("gauges", {}).get("quality/score_psi", 0.0))
+        if v >= score_psi_thr:
+            return {"value": round(v, 4), "threshold": score_psi_thr,
+                    "detail": "prediction-score drift: PSI %.3f vs the "
+                              "training-score histogram (threshold "
+                              "%.2f)" % (v, score_psi_thr)}
+        return None
+
+    def label_drift(snap, state):
+        v = float(snap.get("gauges", {}).get("quality/label_psi", 0.0))
+        if v >= label_psi_thr:
+            return {"value": round(v, 4), "threshold": label_psi_thr,
+                    "detail": "label drift: PSI %.3f vs the training "
+                              "label histogram (threshold %.2f)"
+                              % (v, label_psi_thr)}
+        return None
+
+    def retrain_required(snap, state):
+        # sustained mass in the grid's catch-all edge bins means the
+        # frozen bin boundaries no longer cover the data: a refresh
+        # (refit/resume on the same mappers) cannot fix that — only a
+        # full retrain (new spill, new mappers) can. Counted per
+        # DRAINED window (quality/windows delta), needs
+        # LIGHTGBM_TPU_WATCH_EDGE_WINDOWS consecutive breaching
+        # windows so one weird batch cannot demand a retrain
+        counters = snap.get("counters", {})
+        wins = float(counters.get("quality/windows", 0.0))
+        prev = state.get("prev_windows")
+        state["prev_windows"] = wins
+        if prev is not None and wins > prev:
+            em = float(snap.get("gauges", {})
+                       .get("quality/edge_mass", 0.0))
+            state["streak"] = state.get("streak", 0) + 1 \
+                if em >= edge_thr else 0
+            state["last_em"] = em
+        if state.get("streak", 0) >= edge_windows:
+            return {"value": round(state.get("last_em", 0.0), 4),
+                    "threshold": edge_thr,
+                    "windows": state["streak"],
+                    "detail": "%.0f%% excess mass in overflow/edge "
+                              "bins for %d consecutive windows — the "
+                              "frozen bin boundaries no longer cover "
+                              "the data; refresh cycles cannot fix "
+                              "this, schedule a full retrain (new "
+                              "spill, new mappers)"
+                              % (100 * state.get("last_em", 0.0),
+                                 state["streak"])}
+        return None
+
     return [WatchRule("retrace_spike", retrace_spike),
             WatchRule("backend_fallback", backend_fallback),
             WatchRule("queue_saturation", queue_saturation),
@@ -356,7 +441,15 @@ def default_rules() -> List[WatchRule]:
             WatchRule("fault_storm", fault_storm),
             WatchRule("shed_rate", shed_rate),
             WatchRule("breaker_open", breaker_open),
-            WatchRule("refresh_slo", refresh_slo)]
+            WatchRule("refresh_slo", refresh_slo),
+            WatchRule("feature_drift", feature_drift,
+                      component="obs.quality"),
+            WatchRule("prediction_drift", prediction_drift,
+                      component="obs.quality"),
+            WatchRule("label_drift", label_drift,
+                      component="obs.quality"),
+            WatchRule("retrain_required", retrain_required,
+                      component="obs.quality")]
 
 
 def fleet_rules() -> List[WatchRule]:
@@ -497,7 +590,10 @@ class Watchdog:
                 continue
             breached = detail is not None
             if breached and not self._breached.get(rule.name, False):
-                rec = dict(rule=rule.name, severity="warning", **detail)
+                rec = dict(rule=rule.name, severity="warning",
+                           component=getattr(rule, "component",
+                                             "obs.health"),
+                           **detail)
                 self._last_fired[rule.name] = rec
                 fired.append(rec)
                 self.reg.inc("health/" + rule.name)

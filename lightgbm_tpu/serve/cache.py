@@ -54,7 +54,7 @@ class BucketedPredictor:
                  min_bucket: int = 16, max_bucket: int = 1 << 16,
                  output_kind: str = "value",
                  entries: Optional[Dict[Tuple, int]] = None,
-                 entries_lock=None):
+                 entries_lock=None, quality=None):
         import threading
         if output_kind not in _KINDS:
             raise ValueError("output_kind must be one of %s" % (_KINDS,))
@@ -63,6 +63,10 @@ class BucketedPredictor:
         self.min_bucket = max(int(min_bucket), 1)
         self.max_bucket = max(int(max_bucket), self.min_bucket)
         self.output_kind = output_kind
+        # optional obs.quality.QualityMonitor: every dispatched chunk
+        # also lands one on-device scatter-add into the drift window
+        # (shared across replicas exactly like `entries`)
+        self.quality = quality
         # (model_version, bucket, kind[, "dd"]) -> dispatch count.
         # When `entries` is shared across replica dispatch threads the
         # caller passes ONE `entries_lock` too: insert/increment/purge
@@ -148,6 +152,12 @@ class BucketedPredictor:
                 obs.gauge("serve/compile_cache_size", size)
             else:
                 obs.inc("serve/bucket_hit")
+            if self.quality is not None:
+                # drift window accumulation: same bucket-padded chunk,
+                # real-row count rides in as a traced scalar so the
+                # window adds zero traces beyond the warmed buckets
+                self.quality.accumulate(chunk, m,
+                                        device=self.forest.device)
             outs.append(self._dispatch(kind, chunk, dd)[:m])
         return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
 

@@ -520,7 +520,7 @@ class PredictServer:
                  default_deadline_ms: Optional[float] = None,
                  breaker_threshold: int = 5,
                  breaker_cooldown_ms: float = 2000.0,
-                 replicas=1):
+                 replicas=1, quality=None):
         if isinstance(model, ModelRegistry):
             self.registry = model
         else:
@@ -561,10 +561,19 @@ class PredictServer:
             placed = [forest]  # single replica: follow the default device
         else:
             placed = [forest.place(d) for d in self._devices]
+        # data-quality monitor (obs/quality.py): ONE monitor shared by
+        # every replica's predictor — its device window state is keyed
+        # by device under its own lock, the same sharing contract as
+        # `shared_entries`; drained on the exporter tick, not per batch
+        self.quality = quality
+        if self.quality is not None:
+            from ..obs import quality as obs_quality
+            obs_quality.register_monitor(self.quality)
         self.predictors = [BucketedPredictor(
             placed[k], model_version=version, min_bucket=min_bucket,
             max_bucket=mb, output_kind=output_kind,
-            entries=shared_entries, entries_lock=shared_entries_lock)
+            entries=shared_entries, entries_lock=shared_entries_lock,
+            quality=quality)
             for k in range(self.replicas)]
         self.predictor = self.predictors[0]
         obs.gauge("serve/replicas", self.replicas)
@@ -705,6 +714,9 @@ class PredictServer:
                             unresolved=len(stranded),
                             drain_timeout_s=float(drain_timeout_s))
             obs_events.flush()
+        if self.quality is not None:
+            from ..obs import quality as obs_quality
+            obs_quality.unregister_monitor(self.quality)
         if self.pusher is not None:
             # one final push so the gateway sees the drained terminal
             # counters, then stop the loop
@@ -1069,6 +1081,11 @@ class PredictServer:
                 return
         dt = time.perf_counter() - t0
         self.breaker.record_success()
+        if self.quality is not None:
+            # prediction-score drift: the scores are already host-side
+            # on their way back to the callers — one np.histogram here,
+            # drained with the feature window at the exporter tick
+            self.quality.observe_scores(y)
         if canary:
             self.registry.canary_result(self.name, version, ok=True)
         now = time.perf_counter()

@@ -1,0 +1,486 @@
+"""Data & model quality plane (obs/quality.py): binned drift detection.
+
+Acceptance pins:
+
+- PSI / Jensen-Shannon match an independent float64 NumPy oracle over
+  the documented smoothing (eps floor, renormalize), including the
+  empty-window, all-zero-bin, and zero-count-bin edge cases; JS is
+  symmetric and bounded to [0, 1].
+- The spill-time :class:`ProfileBuilder` counts equal a per-value
+  ``BinMapper.value_to_bin`` bincount oracle, NaN and zero sentinel
+  lanes included, and the profile survives both the spill-manifest and
+  the checkpoint round-trip (a checkpoint missing its optional
+  ``quality_profile.json`` still loads).
+- The windowed :class:`QualityMonitor` drained concurrently from N
+  replica threads loses no counts and never tears a window (every
+  drain is a whole number of chunks); under-filled windows are CARRIED,
+  not scored as sampling noise.
+- A warmed serve dispatch with quality accumulation runs under
+  ``transfer_guard("disallow")`` with ZERO new traces per window, and
+  an injected covariate shift fires the ``feature_drift`` watchdog
+  (component ``obs.quality``) while a clean window stays quiet.
+"""
+import glob
+import json
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.basic import Dataset
+from lightgbm_tpu.engine import train
+from lightgbm_tpu.io.shards import ShardedBinnedDataset
+from lightgbm_tpu.io.streaming import StreamingDataset
+from lightgbm_tpu.obs import compile as obs_compile
+from lightgbm_tpu.obs import health as obs_health
+from lightgbm_tpu.obs.quality import (QualityMonitor, ReferenceProfile,
+                                      fixed_histogram, histogram_edges,
+                                      js_divergence, psi)
+from lightgbm_tpu.obs.registry import MetricsRegistry
+from lightgbm_tpu.serve import ModelRegistry, PredictServer, StackedForest
+
+kRows = 900
+kFeatures = 6
+kParams = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+           "verbosity": -1, "min_data_in_leaf": 10,
+           "bin_construct_sample_cnt": kRows,
+           "categorical_feature": [4]}
+
+
+def _quality_data():
+    """Covers every sentinel lane: a NaN-heavy column, an exact-zero
+    heavy column, and a categorical column."""
+    rng = np.random.default_rng(17)
+    X = rng.normal(size=(kRows, kFeatures))
+    X[rng.random(kRows) < 0.12, 2] = np.nan
+    X[rng.random(kRows) < 0.55, 3] = 0.0
+    X[:, 4] = rng.integers(0, 7, kRows)
+    y = (np.nan_to_num(X[:, 0]) + 0.5 * np.nan_to_num(X[:, 1])
+         > 0.2).astype(np.float64)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def pipeline(tmp_path_factory):
+    """One spill -> train -> profile -> packed forest pipeline shared
+    module-wide (single-core CPU budget). The spill pass is what stamps
+    the reference profile, so every test here rides the REAL capture
+    path rather than a hand-built profile."""
+    spill = str(tmp_path_factory.mktemp("quality_spill"))
+    X, y = _quality_data()
+    sd = StreamingDataset(kFeatures, params=kParams)
+    for lo in range(0, kRows, 300):
+        sd.push_rows(X[lo:lo + 300], label=y[lo:lo + 300])
+    sharded = sd.finalize(spill_dir=spill, shard_rows=300)
+    ds = Dataset(None)
+    ds._handle = sharded
+    ds.params = dict(kParams)
+    bst = train(dict(kParams), ds, num_boost_round=3)
+    profile = bst.inner.quality_profile
+    assert profile is not None, "spill pass produced no profile"
+    profile.attach_scores(np.asarray(bst.inner.train_score,
+                                     dtype=np.float32),
+                          objective=bst.inner.objective)
+    forest = StackedForest.from_gbdt(bst)
+    return SimpleNamespace(X=X, y=y, spill=spill, sharded=sharded,
+                           bst=bst, profile=profile, forest=forest)
+
+
+def _reg():
+    reg = MetricsRegistry()
+    reg.enable()
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# drift math vs an independent f64 oracle
+# ---------------------------------------------------------------------------
+
+def _oracle_probs(counts, eps):
+    """Independent reimplementation of the documented smoothing: counts
+    to probabilities, floor at eps, renormalize; None when empty."""
+    c = np.asarray(counts, dtype=np.float64).ravel()
+    if c.size == 0 or c.sum() <= 0:
+        return None
+    p = np.maximum(c / c.sum(), eps)
+    return p / p.sum()
+
+
+def _oracle_psi(ref, live, eps=1e-4):
+    p, q = _oracle_probs(ref, eps), _oracle_probs(live, eps)
+    if p is None or q is None:
+        return 0.0
+    return float(np.sum((q - p) * np.log(q / p)))
+
+
+def _oracle_js(ref, live, eps=1e-12):
+    p, q = _oracle_probs(ref, eps), _oracle_probs(live, eps)
+    if p is None or q is None:
+        return 0.0
+    m = 0.5 * (p + q)
+    kl_pm = np.sum(p * np.log2(p / m))
+    kl_qm = np.sum(q * np.log2(q / m))
+    return float(0.5 * kl_pm + 0.5 * kl_qm)
+
+
+class TestDriftMath:
+    def test_psi_identical_is_zero(self):
+        c = np.array([5, 0, 12, 3, 0, 40], dtype=np.int64)
+        assert psi(c, c) == 0.0
+        assert js_divergence(c, c) == 0.0
+
+    def test_psi_matches_oracle_on_random_counts(self):
+        rng = np.random.default_rng(0)
+        for _ in range(25):
+            n = int(rng.integers(2, 64))
+            ref = rng.integers(0, 50, n)
+            live = rng.integers(0, 50, n)
+            # force some zero-count bins on each side
+            ref[rng.integers(0, n)] = 0
+            live[rng.integers(0, n)] = 0
+            assert psi(ref, live) == pytest.approx(
+                _oracle_psi(ref, live), rel=1e-12, abs=1e-12)
+            assert js_divergence(ref, live) == pytest.approx(
+                _oracle_js(ref, live), rel=1e-12, abs=1e-12)
+
+    def test_empty_and_all_zero_sides_score_zero(self):
+        c = np.array([3, 1, 4], dtype=np.int64)
+        z = np.zeros(3, dtype=np.int64)
+        e = np.array([], dtype=np.int64)
+        for a, b in [(e, e), (z, z), (c, z), (z, c), (c, e), (e, c)]:
+            assert psi(a, b) == 0.0
+            assert js_divergence(a, b) == 0.0
+
+    def test_zero_count_bins_stay_finite(self):
+        # all live mass lands where the reference has none: the eps
+        # floor must keep the logs finite (and large, not inf)
+        ref = np.array([100, 100, 0], dtype=np.int64)
+        live = np.array([0, 0, 100], dtype=np.int64)
+        v = psi(ref, live)
+        assert np.isfinite(v) and v > 1.0
+        assert v == pytest.approx(_oracle_psi(ref, live), rel=1e-12)
+        j = js_divergence(ref, live)
+        assert np.isfinite(j) and 0.0 <= j <= 1.0
+
+    def test_js_symmetric_and_bounded(self):
+        rng = np.random.default_rng(1)
+        for _ in range(10):
+            a = rng.integers(0, 30, 16)
+            b = rng.integers(0, 30, 16)
+            ab, ba = js_divergence(a, b), js_divergence(b, a)
+            assert ab == pytest.approx(ba, abs=1e-12)
+            assert 0.0 <= ab <= 1.0
+        # fully disjoint support is maximal divergence
+        assert js_divergence([50, 0], [0, 50]) == pytest.approx(
+            1.0, abs=1e-6)
+
+    def test_fixed_histogram_overflow_lanes(self):
+        edges = [0.0, 1.0, 2.0]
+        vals = np.array([-5.0, 0.5, 0.7, 1.5, 99.0, np.nan, np.inf])
+        h = fixed_histogram(vals, edges)
+        assert h.tolist() == [1, 2, 1, 1]  # under, (0,1], (1,2], over
+        assert h.sum() == 5                # NaN / inf dropped
+
+    def test_histogram_edges_margins_and_degenerate(self):
+        e = histogram_edges(np.array([0.0, 10.0]), bins=5)
+        assert len(e) == 4
+        assert e[0] < 0.0 and e[-1] > 10.0  # 10% margin each side
+        d = histogram_edges(np.array([3.0, 3.0, 3.0]), bins=5)
+        assert d[0] < 3.0 < d[-1]           # degenerate span widened
+        z = histogram_edges(np.array([np.nan]), bins=5)
+        assert len(z) == 4                  # no finite values: still a grid
+
+
+# ---------------------------------------------------------------------------
+# reference profile: capture oracle + persistence round-trips
+# ---------------------------------------------------------------------------
+
+class TestReferenceProfile:
+    def test_counts_match_value_to_bin_oracle(self, pipeline):
+        p = pipeline.profile
+        assert p.num_rows == kRows
+        mappers = pipeline.sharded.bin_mappers
+        for j, raw in enumerate(p.used):
+            bins = np.asarray(mappers[j].value_to_bin(pipeline.X[:, raw]),
+                              dtype=np.int64)
+            oracle = np.bincount(bins, minlength=int(mappers[j].num_bin))
+            assert np.array_equal(p.counts[j], oracle), \
+                "feature %d counts diverge from ValueToBin" % raw
+            # every row lands in exactly one bin per feature
+            assert int(p.counts[j].sum()) == kRows
+
+    def test_nan_and_zero_sentinel_lanes(self, pipeline):
+        p = pipeline.profile
+        mappers = pipeline.sharded.bin_mappers
+        by_raw = {f: j for j, f in enumerate(p.used)}
+        nan_rows = int(np.isnan(pipeline.X[:, 2]).sum())
+        assert nan_rows > 0
+        j = by_raw[2]
+        nan_bin = int(mappers[j].value_to_bin(np.nan))
+        assert int(p.counts[j][nan_bin]) == nan_rows
+        zero_rows = int((pipeline.X[:, 3] == 0.0).sum())
+        assert zero_rows > kRows // 3
+        j = by_raw[3]
+        zero_bin = int(mappers[j].value_to_bin(0.0))
+        assert int(p.counts[j][zero_bin]) >= zero_rows
+
+    def test_json_roundtrip(self, pipeline, tmp_path):
+        path = str(tmp_path / "profile.json")
+        pipeline.profile.dump(path)
+        back = ReferenceProfile.load(path)
+        # canonical-JSON equality: bin_upper_bound carries a NaN
+        # sentinel on the missing-value feature, and NaN != NaN would
+        # fail a plain dict compare despite a value-faithful round-trip
+        assert json.dumps(back.to_dict(), sort_keys=True) \
+            == json.dumps(pipeline.profile.to_dict(), sort_keys=True)
+
+    def test_spill_manifest_reload(self, pipeline):
+        attached = ShardedBinnedDataset.attach(pipeline.spill)
+        back = attached.quality_profile
+        assert back is not None
+        assert back.used == pipeline.profile.used
+        for a, b in zip(back.counts, pipeline.profile.counts):
+            assert np.array_equal(a, b)
+        assert back.label_hist == pipeline.profile.label_hist
+
+    def test_checkpoint_roundtrip_and_optional_file(self, pipeline,
+                                                    tmp_path):
+        ckdir = str(tmp_path / "ck")
+        pipeline.bst.inner.save_checkpoint(ckdir)
+        qp = glob.glob(os.path.join(ckdir, "**", "quality_profile.json"),
+                       recursive=True)
+        assert qp, "checkpoint did not persist the quality profile"
+
+        # a fresh learner over the re-attached spill (the elastic
+        # resume shape), profile nulled so the restore provably comes
+        # from the checkpoint, not from the spill manifest
+        attached = ShardedBinnedDataset.attach(pipeline.spill)
+        ds = Dataset(None)
+        ds._handle = attached
+        ds.params = dict(kParams)
+        bst2 = train(dict(kParams), ds, num_boost_round=1)
+        bst2.inner.quality_profile = None
+        assert bst2.inner.load_checkpoint(ckdir) is not None
+        back = bst2.inner.quality_profile
+        assert back is not None
+        assert back.used == pipeline.profile.used
+        for a, b in zip(back.counts, pipeline.profile.counts):
+            assert np.array_equal(a, b)
+        # the save path stamps the score histogram (serving space)
+        assert back.score_hist is not None
+        assert sum(back.score_hist["counts"]) == kRows
+
+        # tampering: the profile file is manifest-hashed like every
+        # other checkpoint member, so deleting it must read as a
+        # corrupt checkpoint (skipped), not as silently "no profile"
+        for f in qp:
+            os.unlink(f)
+        assert bst2.inner.load_checkpoint(ckdir) is None
+
+        # pre-quality-plane checkpoints never wrote the file: a save
+        # from a profile-less learner omits it and loads back clean
+        # (profile stays None, no error)
+        ckdir2 = str(tmp_path / "ck_no_profile")
+        bst2.inner.quality_profile = None
+        bst2.inner.save_checkpoint(ckdir2)
+        assert not glob.glob(os.path.join(ckdir2, "**",
+                                          "quality_profile.json"),
+                             recursive=True)
+        bst2.inner.quality_profile = None
+        assert bst2.inner.load_checkpoint(ckdir2) is not None
+        assert bst2.inner.quality_profile is None
+
+
+# ---------------------------------------------------------------------------
+# windowed monitor: scoring, carry, replica concurrency
+# ---------------------------------------------------------------------------
+
+def _shifted(X):
+    return np.ascontiguousarray(
+        X + 2.5 * np.nanstd(X, axis=0, keepdims=True) + 0.5,
+        dtype=np.float32)
+
+
+class TestQualityMonitor:
+    def test_clean_vs_shifted_window(self, pipeline):
+        mon = QualityMonitor(pipeline.forest, profile=pipeline.profile)
+        reg = _reg()
+        blk = np.ascontiguousarray(pipeline.X[:512], dtype=np.float32)
+        mon.accumulate(blk, blk.shape[0], device=pipeline.forest.device)
+        clean = mon.drain(reg)
+        assert clean["rows"] == 512 and not clean["carried"]
+        assert clean["psi_max"] < 0.25, clean
+        assert 0.0 <= clean["js_max"] <= 1.0
+
+        mon.accumulate(_shifted(pipeline.X[:512]), 512,
+                       device=pipeline.forest.device)
+        drifted = mon.drain(reg)
+        assert drifted["psi_max"] >= 0.25, drifted
+        assert drifted["worst_feature"] in pipeline.profile.used
+        # way off the grid: mass piles into the catch-all edge bins
+        assert drifted["edge_mass"] > 0.0
+        snap = reg.snapshot()
+        assert snap["gauges"]["quality/psi_max"] \
+            == pytest.approx(drifted["psi_max"])
+        assert snap["counters"]["quality/windows"] == 2
+        assert snap["counters"]["quality/rows"] == 1024
+
+    def test_min_window_rows_carries_underfilled(self, pipeline):
+        mon = QualityMonitor(pipeline.forest, profile=pipeline.profile,
+                             min_window_rows=100)
+        reg = _reg()
+        dev = pipeline.forest.device
+        blk = np.ascontiguousarray(pipeline.X[:40], dtype=np.float32)
+        mon.accumulate(blk, 40, device=dev)
+        rep = mon.drain(reg)
+        assert rep["carried"] and rep["rows"] == 0
+        assert rep["pending_rows"] == 40
+        # a carried window publishes nothing and scores nothing
+        assert reg.snapshot()["counters"].get("quality/windows", 0) == 0
+        mon.accumulate(np.ascontiguousarray(pipeline.X[40:100],
+                                            dtype=np.float32),
+                       60, device=dev)
+        rep = mon.drain(reg)
+        assert not rep["carried"] and rep["rows"] == 100
+        assert rep["psi"], "filled window was not scored"
+
+    def test_score_and_label_histograms(self, pipeline):
+        mon = QualityMonitor(pipeline.forest, profile=pipeline.profile)
+        reg = _reg()
+        dev = pipeline.forest.device
+        blk = np.ascontiguousarray(pipeline.X[:256], dtype=np.float32)
+        mon.accumulate(blk, 256, device=dev)
+        # replaying the training scores/labels is by construction the
+        # reference distribution: both PSI lanes must read ~0
+        scores = pipeline.bst.inner.objective.convert_output(
+            np.asarray(pipeline.bst.inner.train_score, dtype=np.float64))
+        mon.observe_scores(scores)
+        mon.observe_labels(pipeline.y)
+        rep = mon.drain(reg)
+        assert rep["score_psi"] is not None and rep["score_psi"] < 0.05
+        assert rep["label_psi"] is not None and rep["label_psi"] < 0.05
+
+        mon.accumulate(blk, 256, device=dev)
+        mon.observe_scores(np.full(600, 0.999))   # collapsed scores
+        mon.observe_labels(np.ones(kRows))        # degenerate labels
+        rep = mon.drain(reg)
+        assert rep["score_psi"] >= 0.25
+        assert rep["label_psi"] >= 0.25
+
+    def test_concurrent_replica_accumulate_no_lost_or_torn(self,
+                                                           pipeline):
+        """N replica threads pump fixed-size chunks into the SHARED
+        monitor while the exporter thread drains concurrently: the
+        grand total across drains is exact (no lost counts) and every
+        drained window is a whole number of chunks (no torn windows)."""
+        mon = QualityMonitor(pipeline.forest, profile=pipeline.profile)
+        reg = _reg()
+        dev = pipeline.forest.device
+        blk_rows, n_threads, n_blocks = 32, 4, 12
+        blk = np.ascontiguousarray(pipeline.X[:blk_rows],
+                                   dtype=np.float32)
+        start = threading.Barrier(n_threads + 1)
+
+        def pump():
+            start.wait()
+            for _ in range(n_blocks):
+                mon.accumulate(blk, blk_rows, device=dev)
+
+        threads = [threading.Thread(target=pump)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        start.wait()
+        drains = []
+        while any(t.is_alive() for t in threads):
+            rep = mon.drain(reg)
+            if rep["rows"]:
+                drains.append(rep["rows"])
+            time.sleep(0.002)
+        for t in threads:
+            t.join()
+        rep = mon.drain(reg)
+        if rep["rows"]:
+            drains.append(rep["rows"])
+        assert sum(drains) == n_threads * n_blocks * blk_rows
+        for rows in drains:
+            assert rows % blk_rows == 0, \
+                "torn window: %d rows is not whole chunks" % rows
+        snap = reg.snapshot()
+        assert snap["counters"]["quality/rows"] \
+            == n_threads * n_blocks * blk_rows
+
+
+# ---------------------------------------------------------------------------
+# end to end through the serving plane
+# ---------------------------------------------------------------------------
+
+class TestServeDrift:
+    def _server(self, pipeline, mon):
+        reg = ModelRegistry()
+        reg.load("q", booster=pipeline.bst)
+        return PredictServer(reg, name="q", max_batch=256, max_wait_ms=1,
+                             quality=mon)
+
+    def test_warmed_dispatch_guard_clean_zero_retrace(self, pipeline):
+        """Quality accumulation on the dispatch path must stay
+        transfer-clean (explicit puts only, nothing read back) and must
+        not retrace once its bucket is warm."""
+        import jax
+
+        mon = QualityMonitor(pipeline.forest, profile=pipeline.profile)
+        srv = self._server(pipeline, mon)
+        reg = _reg()
+        blk = pipeline.X[:64]
+        try:
+            for _ in range(2):  # warm the bucket + the accum trace
+                srv.predict(blk, timeout=60)
+            mon.drain(reg)      # warm rows are not window 1
+            before = obs_compile.trace_count("quality.window_accum")
+            jax.config.update("jax_transfer_guard", "disallow")
+            try:
+                out = srv.predict(blk, timeout=60)
+            finally:
+                jax.config.update("jax_transfer_guard", "allow")
+            assert out.shape[0] == 64
+            after = obs_compile.trace_count("quality.window_accum")
+            assert after == before, "quality accum retraced per window"
+            rep = mon.drain(reg)
+            assert rep["rows"] == 64
+        finally:
+            srv.stop()
+
+    def test_shift_fires_feature_drift_watchdog(self, pipeline):
+        """Injected covariate shift through the REAL serve dispatch
+        breaches within one window and fires the feature_drift rule
+        (truthful component); the unshifted window stays quiet."""
+        mon = QualityMonitor(pipeline.forest, profile=pipeline.profile)
+        srv = self._server(pipeline, mon)
+        reg = _reg()
+        wd = obs_health.Watchdog(reg=reg)
+        drift_rules = {"feature_drift", "prediction_drift",
+                       "label_drift", "retrain_required"}
+        try:
+            srv.predict(pipeline.X[:512], timeout=60)
+            clean = mon.drain(reg)
+            assert clean["rows"] >= 512
+            fired = {r["rule"] for r in wd.evaluate()}
+            assert not (fired & drift_rules), \
+                "clean serve window fired %s" % (fired & drift_rules)
+
+            srv.predict(_shifted(pipeline.X[:512]), timeout=60)
+            drifted = mon.drain(reg)
+            assert drifted["psi_max"] >= 0.25, drifted
+            fired = wd.evaluate()
+            by_rule = {r["rule"]: r for r in fired}
+            assert "feature_drift" in by_rule, fired
+            assert by_rule["feature_drift"]["component"] == "obs.quality"
+            assert by_rule["feature_drift"]["feature"] \
+                == str(drifted["worst_feature"])
+            snap = reg.snapshot()
+            assert snap["counters"]["health/feature_drift"] == 1
+        finally:
+            srv.stop()

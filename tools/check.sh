@@ -56,6 +56,80 @@ assert parse_openmetrics(render_openmetrics(reg)) == parsed
 print("round-trip ok (%d samples)" % len(parsed))
 EOF
 
+echo "== drift smoke (quality plane: clean vs shifted window) =="
+# Bounded quality-plane pass: spill a tiny training set (the reference
+# profile rides the spill manifest), serve-project it onto a packed
+# forest's grid, then score one clean and one covariate-shifted window.
+# The clean window must stay under the PSI threshold, the shifted one
+# must breach it, and `trace_report.py drift` must agree on both dumps.
+DRIFT_CLEAN="$TMP/drift_clean.txt" DRIFT_SHIFT="$TMP/drift_shift.txt" \
+python - <<'EOF'
+import os
+import tempfile
+
+import numpy as np
+
+from lightgbm_tpu.basic import Dataset
+from lightgbm_tpu.engine import train
+from lightgbm_tpu.io.streaming import StreamingDataset
+from lightgbm_tpu.obs.export import render_openmetrics
+from lightgbm_tpu.obs.quality import QualityMonitor
+from lightgbm_tpu.obs.registry import registry as obs
+from lightgbm_tpu.serve.forest import StackedForest
+
+rng = np.random.default_rng(3)
+X = rng.normal(size=(2000, 8))
+y = (X[:, 0] + 0.5 * X[:, 1] > 0.2).astype(np.float64)
+params = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+          "verbosity": -1, "min_data_in_leaf": 10,
+          "bin_construct_sample_cnt": 2000}
+obs.enable()
+sd = StreamingDataset(8, params=params)
+for lo in range(0, 2000, 500):
+    sd.push_rows(X[lo:lo + 500], label=y[lo:lo + 500])
+with tempfile.TemporaryDirectory(prefix="lgbm_tpu_drift_") as spill:
+    sharded = sd.finalize(spill_dir=spill, shard_rows=500)
+    ds = Dataset(None)
+    ds._handle = sharded
+    ds.params = dict(params)
+    bst = train(dict(params), ds, num_boost_round=3)
+profile = getattr(bst.inner, "quality_profile", None)
+assert profile is not None, "spill pass produced no reference profile"
+profile.attach_scores(np.asarray(bst.inner.train_score,
+                                 dtype=np.float32),
+                      objective=bst.inner.objective)
+forest = StackedForest.from_gbdt(bst)
+mon = QualityMonitor(forest, profile=profile)
+
+blk = np.ascontiguousarray(X[:1024], dtype=np.float32)
+mon.accumulate(blk, blk.shape[0], device=forest.device)
+clean = mon.drain(obs)
+assert clean["rows"] == 1024, clean
+assert clean["psi_max"] < 0.25, \
+    "clean window scored drift: %r" % clean
+with open(os.environ["DRIFT_CLEAN"], "w") as f:
+    f.write(render_openmetrics(obs))
+
+shifted = np.ascontiguousarray(
+    X[:1024] + 2.5 * X.std(axis=0, keepdims=True), dtype=np.float32)
+mon.accumulate(shifted, shifted.shape[0], device=forest.device)
+drifted = mon.drain(obs)
+assert drifted["psi_max"] >= 0.25, \
+    "shifted window undetected: %r" % drifted
+with open(os.environ["DRIFT_SHIFT"], "w") as f:
+    f.write(render_openmetrics(obs))
+print("drift smoke ok (clean psi_max %.4f, shifted psi_max %.2f on "
+      "feature %s)" % (clean["psi_max"], drifted["psi_max"],
+                       drifted["worst_feature"]))
+EOF
+
+python tools/trace_report.py drift "$TMP/drift_clean.txt"
+if python tools/trace_report.py drift "$TMP/drift_shift.txt" \
+    > "$TMP/drift_table.txt"; then
+  echo "trace_report drift missed the shifted window"; exit 1
+fi
+cat "$TMP/drift_table.txt"
+
 echo "== refresh-loop smoke (2 cycles, poisoned canary) =="
 # Bounded closed-loop pass: bootstrap + one POISONED refresh under live
 # traffic. Nonzero exit on a stranded future, an SLO breach, a missed

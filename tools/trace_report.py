@@ -50,6 +50,16 @@ Subcommands::
         (or a directory mixing formats) comes out span-for-span equal
         to what the JSON writer would have produced.
 
+    trace_report.py drift metrics.txt|http://gateway:port
+        Per-feature drift table from the quality plane's metric
+        families (``lightgbm_tpu_quality_*`` — see
+        lightgbm_tpu/obs/quality.py): PSI and Jensen-Shannon per
+        feature, prediction-score / label drift, edge-bin mass, window
+        size, and any fired drift watchdog rules. ``--threshold``
+        moves the PSI flagging cut (default 0.25), ``--json`` emits
+        the raw report instead of the table. Exit 1 when any feature
+        breaches the threshold (scriptable drift check).
+
     trace_report.py fleet segdir/ metrics.txt|http://gateway:port
         Run-correlated fleet report: joins a trace-segment directory
         with a gateway metrics dump (a file, or a live gateway URL to
@@ -579,6 +589,81 @@ def fleet_report(tracedir: str, metrics_text: str,
     }
 
 
+kDriftRules = ("feature_drift", "prediction_drift", "label_drift",
+               "retrain_required")
+
+
+def drift_report(metrics_text: str, threshold: float = 0.25) -> dict:
+    """Per-feature drift report from an OpenMetrics dump: the
+    ``lightgbm_tpu_quality_*`` families the serve-path drift monitor
+    exports each window, joined with the drift watchdog breach
+    counters. ``features`` maps raw feature index -> {psi, js,
+    breach}."""
+    om = _openmetrics()
+    parsed = om.parse_openmetrics(metrics_text)
+    pfx = om.kPrefix
+    qpfx = pfx + "quality_"
+    features: Dict[str, dict] = {}
+    summary: Dict[str, float] = {}
+    breaches: Dict[str, float] = {}
+    for (name, labels), v in sorted(parsed.items()):
+        ld = dict(labels)
+        if name == qpfx + "psi" and "feature" in ld:
+            features.setdefault(str(ld["feature"]), {})["psi"] = v
+        elif name == qpfx + "js" and "feature" in ld:
+            features.setdefault(str(ld["feature"]), {})["js"] = v
+        elif name.startswith(qpfx):
+            key = name[len(qpfx):]
+            if key.endswith("_total"):
+                key = key[:-len("_total")]
+            if not ld:
+                summary[key] = v
+        elif (name.startswith(pfx + "health_")
+              and name.endswith("_total") and v > 0):
+            rule = name[len(pfx + "health_"):-len("_total")]
+            if rule in kDriftRules:
+                breaches[rule] = v
+    for f in features.values():
+        f["breach"] = f.get("psi", 0.0) >= threshold
+    return {
+        "threshold": threshold,
+        "features": dict(sorted(features.items(),
+                                key=lambda kv: -kv[1].get("psi", 0.0))),
+        "summary": summary,
+        "watchdog_breaches": breaches,
+        "drifted": sorted((k for k, f in features.items()
+                           if f["breach"]),
+                          key=lambda k: -features[k].get("psi", 0.0)),
+    }
+
+
+def render_drift(report: dict, out=None) -> None:
+    """Human-readable form of :func:`drift_report`: a summary line, the
+    per-feature table (worst PSI first), and any fired drift rules."""
+    out = out or sys.stdout
+    s = report["summary"]
+    print("quality window: rows=%d windows=%d psi_max=%.4f "
+          "js_max=%.4f score_psi=%s label_psi=%s edge_mass=%.4f"
+          % (int(s.get("window_rows", 0)), int(s.get("windows", 0)),
+             s.get("psi_max", 0.0), s.get("js_max", 0.0),
+             ("%.4f" % s["score_psi"]) if "score_psi" in s else "n/a",
+             ("%.4f" % s["label_psi"]) if "label_psi" in s else "n/a",
+             s.get("edge_mass", 0.0)), file=out)
+    feats = report["features"]
+    if not feats:
+        print("no per-feature quality gauges in this dump (quality "
+              "plane inactive, or no window drained yet)", file=out)
+    else:
+        print("%8s %10s %10s  drift(PSI>=%.2f)"
+              % ("feature", "psi", "js", report["threshold"]), file=out)
+        for k, f in feats.items():
+            print("%8s %10.4f %10.4f  %s"
+                  % (k, f.get("psi", 0.0), f.get("js", 0.0),
+                     "BREACH" if f["breach"] else "-"), file=out)
+    for rule, count in sorted(report["watchdog_breaches"].items()):
+        print("watchdog %s fired: %d" % (rule, int(count)), file=out)
+
+
 def tail_dir(dirpath: str, follow: bool = False, interval: float = 2.0,
              print_spans: bool = False, out=None) -> int:
     """Print a digest (or every span) of each segment as it finalizes.
@@ -646,6 +731,18 @@ def main(argv=None) -> int:
     ap_f.add_argument("metrics",
                       help="gateway metrics dump file, or gateway URL "
                            "to scrape")
+    ap_d = sub.add_parser("drift",
+                          help="per-feature drift table from the "
+                               "quality plane's metric families")
+    ap_d.add_argument("metrics",
+                      help="gateway metrics dump file, or gateway URL "
+                           "to scrape")
+    ap_d.add_argument("--threshold", type=float, default=0.25,
+                      help="PSI at or above this flags a feature as "
+                           "drifted (default 0.25)")
+    ap_d.add_argument("--json", action="store_true",
+                      help="emit the raw report as JSON instead of "
+                           "the table")
     args = ap.parse_args(argv)
 
     if args.cmd == "validate":
@@ -709,6 +806,19 @@ def main(argv=None) -> int:
         print("converted %s -> %s (%d events)"
               % (args.path, args.output, len(doc.get("traceEvents", []))))
         return 0
+
+    if args.cmd == "drift":
+        try:
+            text = fetch_metrics_text(args.metrics)
+            report = drift_report(text, threshold=args.threshold)
+        except (OSError, ValueError) as e:
+            print("drift: %s" % e, file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(report, indent=2))
+        else:
+            render_drift(report)
+        return 1 if report["drifted"] else 0
 
     if args.cmd == "fleet":
         if not os.path.isdir(args.tracedir):
