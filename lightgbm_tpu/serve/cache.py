@@ -31,7 +31,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from ..obs.registry import registry as obs
-from ..utils import next_pow2
+from ..utils import locktrace, next_pow2
 from .forest import StackedForest, f32_exact
 
 _KINDS = ("value", "raw", "leaf", "raw_device")
@@ -75,6 +75,7 @@ class BucketedPredictor:
             entries if entries is not None else {}
         self._entries_lock = (entries_lock if entries_lock is not None
                               else threading.Lock())
+        locktrace.maybe_trace(self)
 
     def swap(self, forest: StackedForest, model_version,
              keep_versions=None) -> None:

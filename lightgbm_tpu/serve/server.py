@@ -86,6 +86,7 @@ from ..obs import events as obs_events
 from ..obs import faults as obs_faults
 from ..obs import health as obs_health
 from ..obs.registry import registry as obs
+from ..utils import locktrace
 from ..utils import log
 from ..utils import next_pow2
 from .cache import BucketedPredictor
@@ -174,6 +175,7 @@ class CircuitBreaker:
         # shared gauge (the watchdog rule scans the whole family)
         self.gauge_name = "serve/breaker_state/" + model
         obs.gauge(self.gauge_name, self._state)
+        locktrace.maybe_trace(self)
 
     @property
     def state(self) -> str:
@@ -265,6 +267,7 @@ class ModelRegistry:
         self._models: Dict[str, tuple] = {}  # name -> (version, forest)
         self._canary: Dict[str, dict] = {}
         self._next_version: Dict[str, int] = {}
+        locktrace.maybe_trace(self)
 
     def load(self, name: str = "default", booster=None,
              model_str: Optional[str] = None,
@@ -381,6 +384,10 @@ class ModelRegistry:
                 # a failure (injected or real) fails CLOSED into the
                 # rollback path, the old version keeps serving
                 try:
+                    # jaxlint: disable=JLT102 -- the promote fault probe
+                    # must stay atomic with the promote decision
+                    # (fail-closed rollback); it only blocks when a
+                    # chaos fault is injected under test
                     obs_faults.check("registry_swap", name=name,
                                      phase="promote")
                 except OSError as e:
@@ -623,6 +630,9 @@ class PredictServer:
             from ..obs.gateway import SnapshotPusher
             self.pusher = SnapshotPusher(metrics_gateway,
                                          role="serve").start()
+        # LOCKTRACE hook: must precede start() — the proxies have to be
+        # in place before the first dispatch thread touches _cond
+        locktrace.maybe_trace(self)
         if autostart:
             self.start()
 

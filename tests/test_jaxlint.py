@@ -573,6 +573,571 @@ class TestJLT007:
 # CLI: JSON output + exit codes (the standalone CI gate)
 # ---------------------------------------------------------------------------
 
+def lint_tree(tmp_path, files, select=None):
+    """Write {relpath: source} under tmp_path and lint the tree as one
+    project (cross-module rules see the full index)."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    report = jaxlint_run([str(tmp_path)], select=select)
+    return report.pop("_findings")
+
+
+# ---------------------------------------------------------------------------
+# JLT008 — cross-function key flow
+# ---------------------------------------------------------------------------
+
+class TestJLT008:
+    def test_fresh_key_from_helper_consumed_twice(self):
+        findings, _ = lint("""
+            import jax
+
+            def make_key(seed):
+                return jax.random.PRNGKey(seed)
+
+            def sample(seed):
+                k = make_key(seed)
+                a = jax.random.uniform(k)
+                b = jax.random.normal(k)
+                return a + b
+        """, select=["JLT008"])
+        assert rules_at(findings) == [("JLT008", 10)]
+        assert "crossed a function boundary" in findings[0].message
+
+    def test_split_between_draws_is_clean(self):
+        findings, _ = lint("""
+            import jax
+
+            def make_key(seed):
+                return jax.random.PRNGKey(seed)
+
+            def sample(seed):
+                k = make_key(seed)
+                k1, k2 = jax.random.split(k)
+                a = jax.random.uniform(k1)
+                b = jax.random.normal(k2)
+                return a + b
+        """, select=["JLT008"])
+        assert findings == []
+
+    def test_passthrough_target_born_consumed(self):
+        # draw() consumed its key parameter AND returned it: the
+        # unpacked alias holds an already-used stream
+        findings, _ = lint("""
+            import jax
+
+            def draw(key):
+                val = jax.random.uniform(key)
+                return val, key
+
+            def use(key):
+                val, fresh = draw(key)
+                extra = jax.random.normal(fresh)
+                return val + extra
+        """, select=["JLT008"])
+        assert rules_at(findings) == [("JLT008", 10)]
+        assert "passed through" in findings[0].message
+
+    def test_passthrough_without_consume_is_clean(self):
+        findings, _ = lint("""
+            import jax
+
+            def wrap(key):
+                return 1.0, key
+
+            def use(key):
+                val, fresh = wrap(key)
+                extra = jax.random.normal(fresh)
+                return val + extra
+        """, select=["JLT008"])
+        assert findings == []
+
+    def test_transitive_helper_chain(self):
+        findings, _ = lint("""
+            import jax
+
+            def outer_key(s):
+                return inner_key(s)
+
+            def inner_key(s):
+                return jax.random.PRNGKey(s)
+
+            def use(s):
+                k = outer_key(s)
+                x = jax.random.uniform(k)
+                y = jax.random.normal(k)
+                return x + y
+        """, select=["JLT008"])
+        assert rules_at(findings) == [("JLT008", 13)]
+
+    def test_key_named_target_stays_jlt002s(self):
+        # a key-named name either rule could see reports exactly ONCE,
+        # under JLT002 (the rule that saw it first)
+        findings, _ = lint("""
+            import jax
+
+            def make_key(seed):
+                return jax.random.PRNGKey(seed)
+
+            def sample(seed):
+                key = make_key(seed)
+                a = jax.random.uniform(key)
+                b = jax.random.normal(key)
+                return a + b
+        """)
+        assert [f.rule for f in findings] == ["JLT002"]
+
+    def test_cross_module_helper(self, tmp_path):
+        findings = lint_tree(tmp_path, {
+            "ops/keys.py": """
+                import jax
+
+                def make_key(seed):
+                    return jax.random.PRNGKey(seed)
+            """,
+            "learner/use.py": """
+                import jax
+                from ops.keys import make_key
+
+                def sample(seed):
+                    k = make_key(seed)
+                    a = jax.random.uniform(k)
+                    b = jax.random.normal(k)
+                    return a + b
+            """,
+        }, select=["JLT008"])
+        assert [(f.rule, f.line) for f in findings] == [("JLT008", 8)]
+
+
+# ---------------------------------------------------------------------------
+# JLT009 — cross-module static-arg call sites
+# ---------------------------------------------------------------------------
+
+_JLT009_OPS = """
+    from obs.compile import instrument_jit
+
+    def _body(a, b, spec):
+        return a
+
+    _hist = instrument_jit("h", _body, static_argnums=(2,))
+"""
+
+
+class TestJLT009:
+    def test_mutable_literal_across_modules(self, tmp_path):
+        findings = lint_tree(tmp_path, {
+            "ops/histo.py": _JLT009_OPS,
+            "learner/use.py": """
+                from ops.histo import _hist
+
+                def go(x, y):
+                    return _hist(x, y, [16, 16])
+            """,
+        }, select=["JLT009"])
+        assert [(f.rule, f.line) for f in findings] == [("JLT009", 5)]
+        assert "static position 2" in findings[0].message
+
+    def test_fresh_ctor_and_nested_tuple(self, tmp_path):
+        findings = lint_tree(tmp_path, {
+            "ops/histo.py": _JLT009_OPS,
+            "learner/use.py": """
+                from ops.histo import _hist
+
+                def go(x, y):
+                    a = _hist(x, y, dict(n=2))
+                    b = _hist(x, y, (1, [2]))
+                    return a + b
+            """,
+        }, select=["JLT009"])
+        assert [(f.rule, f.line) for f in findings] == \
+            [("JLT009", 5), ("JLT009", 6)]
+
+    def test_frozen_tuple_is_clean(self, tmp_path):
+        findings = lint_tree(tmp_path, {
+            "ops/histo.py": _JLT009_OPS,
+            "learner/use.py": """
+                from ops.histo import _hist
+
+                def go(x, y):
+                    return _hist(x, y, (16, 16))
+            """,
+        }, select=["JLT009"])
+        assert findings == []
+
+    def test_same_module_site_is_jlt004s(self, tmp_path):
+        # one finding per site, one owner per gap: the same-file call
+        # must come from JLT004, never doubled by JLT009
+        findings = lint_tree(tmp_path, {
+            "ops/histo.py": """
+                from obs.compile import instrument_jit
+
+                def _body(a, b, spec):
+                    return a
+
+                _hist = instrument_jit("h", _body,
+                                       static_argnums=(2,))
+
+                def go(x, y):
+                    return _hist(x, y, [16, 16])
+            """,
+        })
+        assert [f.rule for f in findings] == ["JLT004"]
+
+
+# ---------------------------------------------------------------------------
+# JLT010 — Pallas kernel invariants
+# ---------------------------------------------------------------------------
+
+class TestJLT010:
+    def test_index_map_arity_vs_grid(self):
+        findings, _ = lint("""
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            PALLAS_VMEM_BUDGET = 1 << 20
+
+            def run(x):
+                return pl.pallas_call(
+                    lambda x_ref, o_ref: None,
+                    grid=(4, 2),
+                    in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((8, 128),
+                                           lambda i, j: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct((32, 128),
+                                                   jnp.float32),
+                )(x)
+        """, relpath="ops/k.py", select=["JLT010"])
+        assert rules_at(findings) == [("JLT010", 12)]
+        assert "grid has 2 dimension" in findings[0].message
+
+    def test_dot_without_preferred_element_type(self):
+        findings, _ = lint("""
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            PALLAS_VMEM_BUDGET = 1 << 20
+
+            def _acc_kernel_body(x_ref, w_ref, o_ref):
+                o_ref[...] = jnp.dot(x_ref[...], w_ref[...])
+        """, relpath="ops/k.py", select=["JLT010"])
+        assert rules_at(findings) == [("JLT010", 8)]
+        assert "preferred_element_type" in findings[0].message
+
+    def test_missing_vmem_budget(self):
+        findings, _ = lint("""
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            def run(x):
+                return pl.pallas_call(
+                    lambda x_ref, o_ref: None,
+                    grid=(1,),
+                    out_shape=jax.ShapeDtypeStruct((8,), jnp.float32),
+                )(x)
+        """, relpath="ops/k.py", select=["JLT010"])
+        assert rules_at(findings) == [("JLT010", 7)]
+        assert "VMEM budget" in findings[0].message
+
+    def test_misaligned_row_tile(self):
+        findings, _ = lint("""
+            from jax.experimental import pallas as pl
+
+            PALLAS_ROW_TILE = 100
+        """, relpath="ops/k.py", select=["JLT010"])
+        assert rules_at(findings) == [("JLT010", 4)]
+
+    def test_invocation_arity_vs_in_specs(self):
+        findings, _ = lint("""
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            PALLAS_VMEM_BUDGET = 1 << 20
+
+            def run(x, w):
+                return pl.pallas_call(
+                    lambda x_ref, w_ref, o_ref: None,
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                              pl.BlockSpec((128, 16),
+                                           lambda i: (0, 0))],
+                    out_specs=pl.BlockSpec((8, 16), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct((32, 16),
+                                                   jnp.float32),
+                )(x)
+        """, relpath="ops/k.py", select=["JLT010"])
+        assert rules_at(findings) == [("JLT010", 9)]
+        assert "invoked with 1 array" in findings[0].message
+
+    def test_consistent_kernel_is_clean(self):
+        findings, _ = lint("""
+            import functools
+
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            PALLAS_ROW_TILE = 2048
+            PALLAS_VMEM_BUDGET = 64 * 1024 * 1024
+
+            def _pallas_fits(nbytes):
+                return nbytes < PALLAS_VMEM_BUDGET
+
+            def _acc_kernel_body(scale, x_ref, w_ref, o_ref):
+                o_ref[...] = jax.lax.dot_general(
+                    x_ref[...], w_ref[...],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+
+            def run(x, w):
+                return pl.pallas_call(
+                    functools.partial(_acc_kernel_body, 3),
+                    grid=(4,),
+                    in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                              pl.BlockSpec((128, 16),
+                                           lambda i: (0, 0))],
+                    out_specs=pl.BlockSpec((8, 16), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct((32, 16),
+                                                   jnp.float32),
+                )(x, w)
+        """, relpath="ops/k.py", select=["JLT010"])
+        assert findings == []
+
+    def test_package_histogram_kernel_is_clean(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.jaxlint",
+             str(REPO / "lightgbm_tpu" / "ops" / "histogram.py"),
+             "--select", "JLT010"],
+            cwd=str(REPO), capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# JLT101/102/103 — concurrency discipline (threaded modules only)
+# ---------------------------------------------------------------------------
+
+_JLT101_BAD = """
+    import threading
+
+    class Server:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.stats = {"n": 0}
+            self._thread = threading.Thread(target=self._run)
+
+        def _run(self):
+            self.stats["n"] += 1
+
+        def read(self):
+            with self._lock:
+                return self.stats["n"]
+"""
+
+
+class TestJLT101:
+    def test_unguarded_worker_write(self):
+        findings, _ = lint(_JLT101_BAD, relpath="serve/x.py",
+                           select=["JLT101"])
+        assert [f.rule for f in findings] == ["JLT101"]
+        assert findings[0].line == 11
+
+    def test_guarded_write_is_clean(self):
+        findings, _ = lint("""
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.stats = {"n": 0}
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    with self._lock:
+                        self.stats["n"] += 1
+
+                def read(self):
+                    with self._lock:
+                        return self.stats["n"]
+        """, relpath="serve/x.py", select=["JLT101"])
+        assert findings == []
+
+    def test_scoped_to_threaded_modules(self):
+        # same source under treelearner/ is out of scope by design
+        findings, _ = lint(_JLT101_BAD, relpath="treelearner/x.py",
+                           select=["JLT101"])
+        assert findings == []
+
+    def test_locked_suffix_contract(self):
+        # a *_locked method writes without the lock (the caller holds
+        # it) — but CALLING it without the lock held is the violation
+        findings, _ = lint("""
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.stats = {"n": 0}
+                    self._thread = threading.Thread(target=self._run)
+
+                def _bump_locked(self):
+                    self.stats["n"] += 1
+
+                def _run(self):
+                    with self._lock:
+                        self._bump_locked()
+
+                def poke(self):
+                    self._bump_locked()
+
+                def read(self):
+                    with self._lock:
+                        return self.stats["n"]
+        """, relpath="serve/x.py", select=["JLT101"])
+        assert [(f.rule, f.line) for f in findings] == [("JLT101", 18)]
+        assert "_locked" in findings[0].message
+
+
+class TestJLT102:
+    def test_sleep_under_lock(self):
+        findings, _ = lint("""
+            import threading
+            import time
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def tick(self):
+                    with self._lock:
+                        time.sleep(0.1)
+        """, relpath="serve/x.py", select=["JLT102"])
+        assert rules_at(findings) == [("JLT102", 11)]
+
+    def test_sleep_outside_lock_is_clean(self):
+        findings, _ = lint("""
+            import threading
+            import time
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def tick(self):
+                    with self._lock:
+                        n = 1
+                    time.sleep(0.1)
+        """, relpath="serve/x.py", select=["JLT102"])
+        assert findings == []
+
+    def test_emit_with_flush_via_helper(self):
+        # the PR 10 shed-accounting bug as a rule: a flushed emit one
+        # call away from the lock still blocks the hot path
+        findings, _ = lint("""
+            import threading
+
+            from ..obs import events
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.shed = 0
+
+                def _account(self):
+                    self.shed += 1
+                    events.emit("shed", n=self.shed)
+                    events.flush()
+
+                def submit(self):
+                    with self._lock:
+                        self._account()
+        """, relpath="serve/x.py", select=["JLT102"])
+        assert [f.rule for f in findings] == ["JLT102"]
+        assert findings[0].line == 18
+
+
+class TestJLT103:
+    def test_inverted_order_in_one_class(self):
+        findings, _ = lint("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """, relpath="serve/x.py", select=["JLT103"])
+        assert {f.rule for f in findings} == {"JLT103"}
+        assert "inversion" in findings[0].message
+
+    def test_consistent_order_is_clean(self):
+        findings, _ = lint("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """, relpath="serve/x.py", select=["JLT103"])
+        assert findings == []
+
+    def test_call_mediated_inversion(self):
+        findings, _ = lint("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def _inner(self):
+                    with self._b:
+                        pass
+
+                def one(self):
+                    with self._a:
+                        self._inner()
+
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """, relpath="serve/x.py", select=["JLT103"])
+        assert {f.rule for f in findings} == {"JLT103"}
+
+
+class TestFamilySelect:
+    def test_jlt10x_wildcard(self):
+        findings, _ = lint(_JLT101_BAD, relpath="serve/x.py",
+                           select=["JLT10x"])
+        assert [f.rule for f in findings] == ["JLT101"]
+
+    def test_unknown_family_is_usage_error(self):
+        with pytest.raises(SystemExit):
+            lint("x = 1", select=["JLT99x"])
+
+
 class TestCLI:
     def test_json_format_and_nonzero_exit(self, tmp_path):
         bad = tmp_path / "bad.py"
@@ -616,6 +1181,49 @@ class TestCLI:
              "--exit-zero"],
             cwd=str(REPO), capture_output=True, text=True)
         assert proc.returncode == 0
+
+
+class TestBaselineCLI:
+    BAD = ("import jax\n\n\ndef f(x):\n"
+           "    return jax.device_get(x)\n")
+
+    def _run(self, *argv):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.jaxlint"] + list(argv),
+            cwd=str(REPO), capture_output=True, text=True)
+
+    def test_known_findings_pass_new_ones_gate(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(self.BAD)
+        base = tmp_path / "baseline.json"
+        proc = self._run(str(bad), "--baseline", str(base),
+                         "--write-baseline")
+        assert proc.returncode == 0 and base.exists()
+        # unchanged file: the known finding is baselined, exit 0
+        proc = self._run(str(bad), "--baseline", str(base))
+        assert proc.returncode == 0, proc.stdout
+        assert "1 known baselined" in proc.stdout
+        # a NEW finding gates, and only it is reported
+        bad.write_text(self.BAD +
+                       "\n\ndef g(y):\n    return jax.device_get(y)\n")
+        proc = self._run(str(bad), "--baseline", str(base))
+        assert proc.returncode == 1
+        assert proc.stdout.count("JLT001") == 1
+        assert ":9:" in proc.stdout  # the new site, not the known one
+
+    def test_missing_baseline_is_usage_error(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(self.BAD)
+        proc = self._run(str(bad), "--baseline",
+                         str(tmp_path / "nope.json"))
+        assert proc.returncode == 2
+
+    def test_list_rules_covers_new_catalog(self):
+        proc = self._run("--list-rules")
+        assert proc.returncode == 0
+        for rid in ("JLT008", "JLT009", "JLT010", "JLT101",
+                    "JLT102", "JLT103", "JLT000", "JLT007"):
+            assert rid in proc.stdout, rid
 
 
 # ---------------------------------------------------------------------------

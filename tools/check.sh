@@ -10,8 +10,51 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 TMP="$(mktemp -d "${TMPDIR:-/tmp}/lgbm_tpu_check.XXXXXX")"
 trap 'rm -rf "$TMP"' EXIT
 
-echo "== jaxlint =="
+echo "== jaxlint (full rule catalog, incl. JLT008-010 + JLT10x) =="
+# Fast pre-commit subset when only touching the threaded modules:
+#   python -m tools.jaxlint --select JLT10x lightgbm_tpu/serve lightgbm_tpu/loop
 python -m tools.jaxlint lightgbm_tpu
+
+echo "== LOCKTRACE serve smoke (runtime lock sanitizer) =="
+# Bounded dynamic leg of the JLT10x family: a warmed PredictServer
+# takes an overload burst with every named lock traced — any lock-order
+# inversion raises at the acquire, hold-budget overruns fail the
+# window assertion.
+LIGHTGBM_TPU_LOCKTRACE=1 python - <<'EOF'
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serve import PredictServer, StackedForest
+from lightgbm_tpu.utils import locktrace
+
+rng = np.random.RandomState(3)
+X = rng.randn(512, 6).astype(np.float32).astype(np.float64)
+y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                 "verbosity": -1, "min_data_in_leaf": 5,
+                 "max_bin": 63},
+                lgb.Dataset(X, label=y), num_boost_round=8)
+srv = PredictServer(StackedForest.from_gbdt(bst), max_batch=32,
+                    max_wait_ms=2, max_queue_rows=64, autostart=False)
+assert isinstance(srv._cond, locktrace.TracedCondition), \
+    "LOCKTRACE did not wrap the server"
+srv.start()
+try:
+    for rows in (1, 8, 32):            # warm every bucket first
+        srv.submit(X[:rows]).result(timeout=120)
+    locktrace.reset()                  # measured window starts here
+    locktrace.tracer().max_hold_s = 2.0
+    futs = [srv.submit(X[i % len(X)]) for i in range(256)]
+    for f in futs:
+        f.exception(timeout=60)        # shed is fine; hangs are not
+finally:
+    srv.stop()
+rep = locktrace.report()
+assert rep["acquires"] > 256, rep["acquires"]
+locktrace.assert_clean()
+print("locktrace ok (%d acquires, %d order edges, 0 violations)"
+      % (rep["acquires"], len(rep["edges"])))
+EOF
 
 echo "== traced smoke run (compact segments) =="
 LIGHTGBM_TPU_TRACE_STREAM="$TMP/trace" \

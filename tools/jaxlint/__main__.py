@@ -3,16 +3,72 @@
 Exit status: 0 when clean, 1 on findings (use ``--exit-zero`` to
 report without gating), 2 on usage errors — so the tier-1 test suite
 and any CI job can run the analyzer as a standalone gate.
+
+Baseline mode (``--baseline FILE``) supports landing a new rule
+against a codebase with pre-existing findings: ``--write-baseline``
+snapshots today's findings; later runs against the same file report
+and gate ONLY on findings not in the snapshot, so new violations
+fail while the known backlog burns down independently.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import sys
+from typing import Dict, List
 
 from . import __version__
-from .engine import run
+from .engine import Finding, run
 from .rules import RULES
+
+_BASELINE_VERSION = 1
+
+
+def _fingerprint(f: Finding) -> str:
+    """Line-number-free identity: findings keep matching their
+    baseline entry while unrelated edits shift the file."""
+    return "%s|%s|%s" % (f.rule, f.path, f.message)
+
+
+def _baseline_counts(findings: List[Finding]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for f in findings:
+        fp = _fingerprint(f)
+        out[fp] = out.get(fp, 0) + 1
+    return out
+
+
+def _write_baseline(path: str, findings: List[Finding]) -> None:
+    doc = {"jaxlint_baseline": _BASELINE_VERSION,
+           "entries": _baseline_counts(findings)}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def _load_baseline(path: str) -> Dict[str, int]:
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) \
+            or doc.get("jaxlint_baseline") != _BASELINE_VERSION:
+        raise ValueError("not a jaxlint baseline file: %s" % path)
+    entries = doc.get("entries", {})
+    return {str(k): int(v) for k, v in entries.items()}
+
+
+def _new_findings(findings: List[Finding],
+                  baseline: Dict[str, int]) -> List[Finding]:
+    """Findings beyond the baselined count per fingerprint — a second
+    occurrence of a known finding is still NEW."""
+    budget = dict(baseline)
+    out = []
+    for f in findings:
+        fp = _fingerprint(f)
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+        else:
+            out.append(f)
+    return out
 
 
 def main(argv=None) -> int:
@@ -29,6 +85,12 @@ def main(argv=None) -> int:
                          "(default: all)")
     ap.add_argument("--exit-zero", action="store_true",
                     help="always exit 0 (report-only mode)")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="findings snapshot: gate only on findings "
+                         "NOT in FILE (see --write-baseline)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings to --baseline "
+                         "FILE and exit 0")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--version", action="version",
                     version="jaxlint " + __version__)
@@ -44,9 +106,31 @@ def main(argv=None) -> int:
                                     "nothing"))
         return 0
 
+    if args.write_baseline and not args.baseline:
+        ap.error("--write-baseline requires --baseline FILE")
+
     select = args.select.split(",") if args.select else None
     report = run(args.paths or ["lightgbm_tpu"], select=select)
     findings = report.pop("_findings")
+
+    if args.baseline and args.write_baseline:
+        _write_baseline(args.baseline, findings)
+        print("jaxlint: wrote baseline of %d finding%s to %s"
+              % (len(findings), "s" * (len(findings) != 1),
+                 args.baseline))
+        return 0
+    if args.baseline:
+        try:
+            baseline = _load_baseline(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print("jaxlint: cannot read baseline: %s" % e,
+                  file=sys.stderr)
+            return 2
+        known = len(findings)
+        findings = _new_findings(findings, baseline)
+        report["findings"] = [f.as_dict() for f in findings]
+        report["baseline"] = {"known": known - len(findings),
+                              "new": len(findings)}
 
     if args.format == "json":
         json.dump(report, sys.stdout, indent=2)
@@ -54,9 +138,12 @@ def main(argv=None) -> int:
     else:
         for f in findings:
             print(f.text())
-        print("jaxlint: %d finding%s (%d suppressed) in %d file%s"
+        tail = ""
+        if "baseline" in report:
+            tail = ", %d known baselined" % report["baseline"]["known"]
+        print("jaxlint: %d finding%s (%d suppressed%s) in %d file%s"
               % (len(findings), "s" * (len(findings) != 1),
-                 report["suppressed"], report["files_scanned"],
+                 report["suppressed"], tail, report["files_scanned"],
                  "s" * (report["files_scanned"] != 1)))
     if findings and not args.exit_zero:
         return 1

@@ -30,6 +30,12 @@ QUANT_MODULES = ("ops/histogram.py", "ops/quantize.py")
 #: site must go through its ``instrument_jit`` so compiles are counted.
 JIT_OWNER = ("obs/compile.py",)
 
+#: modules whose classes run worker threads against shared state — the
+#: JLT10x concurrency-discipline family applies here (and only here:
+#: single-threaded modules get no value from lock-discipline findings).
+THREADED_MODULES = ("serve/", "loop/", "obs/gateway.py",
+                    "obs/export.py", "io/shards.py")
+
 _SUPPRESS_RE = re.compile(
     r"#\s*jaxlint:\s*disable=([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)"
     r"(?:\s*--\s*(\S.*?))?\s*$")
@@ -62,6 +68,10 @@ class FileContext:
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
         self._aliases = _import_aliases(self.tree)
+        #: set by ProjectIndex — every rule run sees a project (a
+        #: single-file run gets a one-file index)
+        self.project = None
+        self.module = ""
 
     # -- module classification -----------------------------------------
     @property
@@ -80,6 +90,11 @@ class FileContext:
     @property
     def owns_jit(self) -> bool:
         return _matches(self.relpath, JIT_OWNER)
+
+    @property
+    def is_threaded_module(self) -> bool:
+        return (not self.is_test
+                and _matches(self.relpath, THREADED_MODULES))
 
     # -- name resolution -----------------------------------------------
     def canonical(self, node: ast.AST) -> Optional[str]:
@@ -218,11 +233,31 @@ class Suppressions:
 # driving
 # ----------------------------------------------------------------------
 
+def expand_select(select: Iterable[str]) -> set:
+    """Normalize a ``--select`` list: uppercase, and expand a trailing
+    ``x``/``X`` as a family wildcard (``JLT10x`` → every registered
+    rule whose id starts with ``JLT10``)."""
+    from .rules import RULES
+    wanted = set()
+    for s in select:
+        tok = s.strip().upper()
+        if tok.endswith("X") and len(tok) > 4:
+            family = {rid for rid in RULES if rid.startswith(tok[:-1])}
+            if not family:
+                raise SystemExit("rule family %r matches nothing "
+                                 "(known: %s)"
+                                 % (s.strip(), ", ".join(sorted(RULES))))
+            wanted |= family
+        else:
+            wanted.add(tok)
+    return wanted
+
+
 def _rules(select: Optional[Iterable[str]] = None):
     from .rules import RULES
     if select is None:
         return list(RULES.values())
-    wanted = {s.strip().upper() for s in select}
+    wanted = expand_select(select)
     wanted.discard("JLT000")  # engine-level rules, always available
     wanted.discard("JLT007")
     unknown = wanted - set(RULES)
@@ -239,8 +274,19 @@ def check_source(source: str, relpath: str = "<string>",
                  ) -> Tuple[List[Finding], int]:
     """Lint one source string; returns (findings, n_suppressed).
     ``relpath`` drives module classification (pass e.g.
-    ``"treelearner/serial.py"`` to simulate a package location)."""
+    ``"treelearner/serial.py"`` to simulate a package location). The
+    project index covers just this file, so cross-function rules see
+    intra-file flow only."""
+    from .project import ProjectIndex
     ctx = FileContext(source, path or relpath, relpath)
+    ProjectIndex([ctx])
+    return _check_ctx(ctx, select)
+
+
+def _check_ctx(ctx: FileContext,
+               select: Optional[Iterable[str]] = None
+               ) -> Tuple[List[Finding], int]:
+    """Run every selected rule over one already-indexed FileContext."""
     sup = Suppressions(ctx.source)
     rules_run = _rules(select)
     raw: List[Finding] = []
@@ -251,7 +297,7 @@ def check_source(source: str, relpath: str = "<string>",
     raw = list(dict.fromkeys(raw))
     findings = [f for f in raw if not sup.active(f.rule, f.line)]
     suppressed = len(raw) - len(findings)
-    sel = None if select is None else {s.strip().upper() for s in select}
+    sel = None if select is None else expand_select(select)
     if sel is None or "JLT000" in sel:
         for line, rules in sup.bare:
             findings.append(Finding(
@@ -313,6 +359,16 @@ def check_file(path: str, root: Optional[str] = None,
                         select=select, path=str(p))
 
 
+def _load_contexts(paths: Sequence[str]) -> List[FileContext]:
+    out: List[FileContext] = []
+    for f, root in iter_py_files(paths):
+        p = Path(f)
+        rel = str(p.resolve().relative_to(Path(root).resolve()))
+        out.append(FileContext(p.read_text(encoding="utf-8"),
+                               str(p), rel))
+    return out
+
+
 def _package_root(file_path: Path) -> Path:
     """Topmost ancestor directory that is itself a package (has an
     ``__init__.py``): linting ``lightgbm_tpu/obs/compile.py`` alone
@@ -352,13 +408,18 @@ def iter_py_files(paths: Sequence[str]):
 def run(paths: Sequence[str],
         select: Optional[Iterable[str]] = None) -> dict:
     """Lint ``paths`` (files or directory trees); returns the report
-    dict the CLI renders (text or JSON)."""
+    dict the CLI renders (text or JSON). All files parse FIRST so the
+    project index (symbol table + call graph) spans every scanned
+    file; cross-function/cross-module rules then run per file against
+    the shared index."""
+    from .project import ProjectIndex
+    contexts = _load_contexts(paths)
+    ProjectIndex(contexts)
     findings: List[Finding] = []
     suppressed = 0
-    n_files = 0
-    for f, root in iter_py_files(paths):
-        n_files += 1
-        got, sup = check_file(f, root=root, select=select)
+    n_files = len(contexts)
+    for ctx in contexts:
+        got, sup = _check_ctx(ctx, select)
         findings.extend(got)
         suppressed += sup
     counts: Dict[str, int] = {}

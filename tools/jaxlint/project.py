@@ -1,0 +1,172 @@
+"""Project-wide analysis: module symbol table + intra-package call
+graph, built once per lint run from every parsed :class:`FileContext`.
+
+The index is deliberately name-based and conservative (stdlib ``ast``
+only, same engine architecture as the per-file pass):
+
+- modules are keyed by their package-relative dotted name
+  (``obs/compile.py`` → ``obs.compile``);
+- a canonical dotted call name (from ``FileContext.canonical``, which
+  resolves import aliases) is matched against module names by SUFFIX,
+  because relative imports resolve to their module tail;
+- ``self.method(...)`` resolves within the enclosing class only — no
+  inheritance, no instance-attribute indirection
+  (``self.registry.get(...)`` does not resolve);
+- an ambiguous symbol (two modules ending in the same tail defining
+  the same name) resolves to nothing rather than to a guess.
+
+Rules reach the index through ``ctx.project`` and stash per-rule
+computed summaries in ``project.cache`` so a full-package run computes
+each fixed point exactly once.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional
+
+__all__ = ["ProjectIndex", "FunctionInfo", "module_name"]
+
+
+def module_name(relpath: str) -> str:
+    """Dotted module name for a package-relative path
+    (``serve/__init__.py`` → ``serve``)."""
+    rp = relpath.replace("\\", "/")
+    if rp.endswith(".py"):
+        rp = rp[:-3]
+    if rp.endswith("/__init__"):
+        rp = rp[: -len("/__init__")]
+    elif rp == "__init__":
+        rp = ""
+    return rp.replace("/", ".")
+
+
+class FunctionInfo:
+    """One top-level function or method: enough identity to resolve
+    calls to it and summarize its body."""
+
+    __slots__ = ("module", "qualname", "cls", "node", "ctx", "params")
+
+    def __init__(self, module: str, qualname: str, cls: Optional[str],
+                 node: ast.AST, ctx) -> None:
+        self.module = module
+        self.qualname = qualname       # "helper" or "Class.method"
+        self.cls = cls                 # enclosing class name, or None
+        self.node = node
+        self.ctx = ctx
+        a = node.args
+        self.params: List[str] = [x.arg for x in
+                                  list(a.posonlyargs) + list(a.args)]
+
+    @property
+    def key(self) -> str:
+        return self.module + ":" + self.qualname
+
+    def param_index(self, call: ast.Call, arg_node: ast.AST
+                    ) -> Optional[int]:
+        """Which parameter of this function a call-site argument lands
+        on (positional by index — ``self`` shifts methods by one; a
+        keyword by name). None when it cannot be told."""
+        offset = 1 if self.cls is not None and self.params \
+            and self.params[0] == "self" else 0
+        for i, arg in enumerate(call.args):
+            if arg is arg_node:
+                idx = i + offset
+                return idx if idx < len(self.params) else None
+        for kw in call.keywords:
+            if kw.value is arg_node and kw.arg in self.params:
+                return self.params.index(kw.arg)
+        return None
+
+
+class ProjectIndex:
+    """Symbol table over every file of one lint run. Single-file runs
+    (``check_source``) get a one-file index, so intra-file
+    cross-function findings behave identically in fixtures and in
+    full-package scans."""
+
+    def __init__(self, contexts) -> None:
+        self.contexts = list(contexts)
+        #: "module:qualname" -> FunctionInfo
+        self.functions: Dict[str, FunctionInfo] = {}
+        #: "module:name" -> module-level ast.Assign binding that name
+        self.module_assigns: Dict[str, ast.Assign] = {}
+        #: per-rule computed summaries (fixed points, call graphs)
+        self.cache: Dict[str, object] = {}
+        self._modules: List[str] = []
+        for ctx in self.contexts:
+            mod = module_name(ctx.relpath)
+            ctx.module = mod
+            ctx.project = self
+            self._modules.append(mod)
+            for stmt in ctx.tree.body:
+                self._index(mod, ctx, stmt, cls=None)
+
+    def _index(self, mod: str, ctx, stmt: ast.stmt,
+               cls: Optional[str]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = cls + "." + stmt.name if cls else stmt.name
+            self.functions[mod + ":" + qual] = FunctionInfo(
+                mod, qual, cls, stmt, ctx)
+        elif isinstance(stmt, ast.ClassDef) and cls is None:
+            for sub in stmt.body:
+                self._index(mod, ctx, sub, cls=stmt.name)
+        elif isinstance(stmt, ast.Assign) and cls is None:
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    self.module_assigns[mod + ":" + tgt.id] = stmt
+
+    # -- resolution ----------------------------------------------------
+    def _match_modules(self, modpath: str) -> Iterator[str]:
+        for mod in self._modules:
+            if mod == modpath or mod.endswith("." + modpath):
+                yield mod
+
+    def resolve_symbol(self, ctx, canon: Optional[str]
+                       ) -> Optional[FunctionInfo]:
+        """FunctionInfo for a canonical dotted name as seen from
+        ``ctx`` (bare names look up the same module; dotted names
+        suffix-match a module + top-level symbol)."""
+        if not canon:
+            return None
+        if "." not in canon:
+            return self.functions.get(ctx.module + ":" + canon)
+        modpath, sym = canon.rsplit(".", 1)
+        hits = [self.functions[m + ":" + sym]
+                for m in self._match_modules(modpath)
+                if m + ":" + sym in self.functions]
+        return hits[0] if len(hits) == 1 else None
+
+    def resolve_call(self, ctx, call: ast.Call,
+                     cls: Optional[str] = None
+                     ) -> Optional[FunctionInfo]:
+        """FunctionInfo a call dispatches to, or None. ``cls`` is the
+        enclosing class for ``self.method(...)`` resolution."""
+        func = call.func
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id == "self":
+            if cls is not None:
+                return self.functions.get(
+                    ctx.module + ":" + cls + "." + func.attr)
+            return None
+        return self.resolve_symbol(ctx, ctx.canonical(func))
+
+    def resolve_assign(self, ctx, canon: Optional[str]):
+        """(module, name, ast.Assign) for a canonical dotted name that
+        is a module-level binding somewhere in the project, or None."""
+        if not canon:
+            return None
+        if "." not in canon:
+            key = ctx.module + ":" + canon
+            got = self.module_assigns.get(key)
+            return (ctx.module, canon, got) if got is not None else None
+        modpath, sym = canon.rsplit(".", 1)
+        hits = [(m, sym, self.module_assigns[m + ":" + sym])
+                for m in self._match_modules(modpath)
+                if m + ":" + sym in self.module_assigns]
+        return hits[0] if len(hits) == 1 else None
+
+    def functions_in(self, ctx) -> Iterator[FunctionInfo]:
+        for fi in self.functions.values():
+            if fi.ctx is ctx:
+                yield fi

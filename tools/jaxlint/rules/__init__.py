@@ -125,7 +125,15 @@ from .jlt003_raw_jit import RawJitRule              # noqa: E402
 from .jlt004_static_args import StaticArgsRule      # noqa: E402
 from .jlt005_collectives import CollectivesRule     # noqa: E402
 from .jlt006_dtype_widening import DtypeWideningRule  # noqa: E402
+from .jlt008_key_flow import KeyFlowRule            # noqa: E402
+from .jlt009_static_callsites import StaticCallSiteRule  # noqa: E402
+from .jlt010_pallas import PallasInvariantsRule     # noqa: E402
+from .jlt1xx_concurrency import (                   # noqa: E402
+    BlockingUnderLockRule, LockOrderRule, UnlockedSharedMutationRule)
 
 RULES = {r.id: r for r in (
     HostSyncRule(), KeyReuseRule(), RawJitRule(), StaticArgsRule(),
-    CollectivesRule(), DtypeWideningRule())}
+    CollectivesRule(), DtypeWideningRule(), KeyFlowRule(),
+    StaticCallSiteRule(), PallasInvariantsRule(),
+    UnlockedSharedMutationRule(), BlockingUnderLockRule(),
+    LockOrderRule())}

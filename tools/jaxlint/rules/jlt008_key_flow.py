@@ -1,0 +1,296 @@
+"""JLT008 — cross-function PRNG key flow.
+
+The gap JLT002 admits by design: JLT002 only knows a name holds a key
+when it is a key-named parameter or is assigned directly from
+``jax.random.PRNGKey/split/fold_in``. A key that crosses a function
+boundary — returned by a helper, or passed through one — is invisible
+to it, so this replays a stream silently:
+
+    def make_key(seed):
+        return jax.random.PRNGKey(seed)
+
+    def sample(seed):
+        k = make_key(seed)
+        a = jax.random.uniform(k)
+        b = jax.random.normal(k)      # same stream as `a` — JLT008
+
+This rule builds per-function summaries over the project call graph
+(:mod:`tools.jaxlint.project`) and closes that gap:
+
+- ``returns fresh key``: the function returns a value derived from
+  ``jax.random.PRNGKey/split/fold_in`` (directly, via a key-returning
+  local, or via another fresh-key-returning project function) — a name
+  assigned from a call to it becomes a tracked key generation;
+- ``passes through``: the function returns one of its own key-named
+  parameters (possibly inside a tuple). At the call site the unpacked
+  target ALIASES the argument: if the callee also consumes that
+  parameter, the target is born already-consumed, so the first draw on
+  it is a replay (``x, key2 = draw(key)`` then ``normal(key2)``);
+- summaries are transitive (fixed point, so ``def a(): return b()``
+  chains resolve), and consumption follows JLT002's conservative rule:
+  any non-deriver call a tracked key is passed to consumes it.
+
+Names already tracked by JLT002 (key-named parameters, direct deriver
+assignments) are deliberately NOT re-tracked here — a reuse either rule
+can see reports exactly once, under the rule that saw it first.
+
+Known limits (documented in docs/STATIC_ANALYSIS.md): resolution is
+name-based (no inheritance, no instance-attribute indirection), tuple
+passthrough positions must be literal, and loop bodies are walked
+twice like JLT002's.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..engine import FileContext, Finding
+from . import Rule, iter_statements_ordered, shallow_walk
+from .jlt002_key_reuse import _DERIVERS, _KEY_PARAM, _State, \
+    _key_expr_name
+
+
+def _is_deriver(ctx, call: ast.Call) -> bool:
+    canon = ctx.canonical(call.func) or ""
+    return (canon.startswith("jax.random.")
+            and canon.rsplit(".", 1)[-1] in _DERIVERS)
+
+
+def _is_jax_random(ctx, call: ast.Call) -> bool:
+    canon = ctx.canonical(call.func) or ""
+    return canon.startswith("jax.random.")
+
+
+class _Summary:
+    """What one function does with keys, from its caller's view."""
+
+    __slots__ = ("returns_fresh", "passthrough", "consumes")
+
+    def __init__(self) -> None:
+        #: return positions yielding a fresh key (-1 = the whole
+        #: return value; 0.. = literal tuple elements)
+        self.returns_fresh: Set[int] = set()
+        #: return position -> parameter index it passes through
+        self.passthrough: Dict[int, int] = {}
+        #: parameter indexes the body consumes (draws from)
+        self.consumes: Set[int] = set()
+
+
+def _summaries(project) -> Dict[str, _Summary]:
+    """Fixed point of per-function key summaries over the call graph."""
+    cached = project.cache.get("jlt008")
+    if cached is not None:
+        return cached
+    sums: Dict[str, _Summary] = {fi.key: _Summary()
+                                 for fi in project.functions.values()}
+    for _ in range(6):  # call chains deeper than this do not resolve
+        changed = False
+        for fi in project.functions.values():
+            if _summarize(project, fi, sums):
+                changed = True
+        if not changed:
+            break
+    project.cache["jlt008"] = sums
+    return sums
+
+
+def _summarize(project, fi, sums: Dict[str, _Summary]) -> bool:
+    ctx = fi.ctx
+    s = sums[fi.key]
+    before = (frozenset(s.returns_fresh), tuple(sorted(s.passthrough.items())),
+              frozenset(s.consumes))
+    params = {p: i for i, p in enumerate(fi.params)}
+    key_params = {p for p in fi.params if _KEY_PARAM.search(p)}
+    # local names known to hold a key (derivers + fresh-returning calls)
+    fresh_locals: Set[str] = set()
+    for stmt in iter_statements_ordered(fi.node.body):
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value,
+                                                       ast.Call):
+            call = stmt.value
+            fresh = _is_deriver(ctx, call)
+            if not fresh:
+                callee = project.resolve_call(ctx, call, cls=fi.cls)
+                fresh = callee is not None \
+                    and bool(sums[callee.key].returns_fresh)
+            if fresh:
+                for tgt in stmt.targets:
+                    elts = tgt.elts if isinstance(
+                        tgt, (ast.Tuple, ast.List)) else [tgt]
+                    for el in elts:
+                        if isinstance(el, ast.Name):
+                            fresh_locals.add(el.id)
+        for node in shallow_walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_jax_random(ctx, node) and not _is_deriver(ctx, node):
+                for arg in list(node.args) + [k.value
+                                              for k in node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id in params:
+                        s.consumes.add(params[arg.id])
+                continue
+            callee = project.resolve_call(ctx, node, cls=fi.cls)
+            if callee is None:
+                continue
+            csum = sums[callee.key]
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in params:
+                    idx = callee.param_index(node, arg)
+                    if idx is not None and idx in csum.consumes:
+                        s.consumes.add(params[arg.id])
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            _summarize_return(ctx, project, fi, stmt.value, params,
+                              key_params, fresh_locals, sums, s)
+    after = (frozenset(s.returns_fresh), tuple(sorted(s.passthrough.items())),
+             frozenset(s.consumes))
+    return after != before
+
+
+def _summarize_return(ctx, project, fi, value, params, key_params,
+                      fresh_locals, sums, s: _Summary) -> None:
+    if isinstance(value, ast.Tuple):
+        items: List[Tuple[int, ast.AST]] = list(enumerate(value.elts))
+    else:
+        items = [(-1, value)]
+    for pos, el in items:
+        if isinstance(el, ast.Call):
+            if _is_deriver(ctx, el):
+                s.returns_fresh.add(pos)
+            else:
+                callee = project.resolve_call(ctx, el, cls=fi.cls)
+                if callee is not None \
+                        and sums[callee.key].returns_fresh:
+                    s.returns_fresh.add(pos)
+        elif isinstance(el, ast.Name):
+            if el.id in key_params:
+                s.passthrough[pos] = params[el.id]
+            elif el.id in fresh_locals:
+                s.returns_fresh.add(pos)
+
+
+class KeyFlowRule(Rule):
+    id = "JLT008"
+    name = "key-flow"
+    summary = ("PRNG key crossing a function boundary (returned or "
+               "passed through) consumed twice")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        project = ctx.project
+        if project is None:
+            return iter(())
+        sums = _summaries(project)
+        out: List[Finding] = []
+        for fi in project.functions_in(ctx):
+            state = _State()
+            origin: Dict[str, str] = {}  # tracked name -> provenance
+            self._walk_block(ctx, project, fi, sums, fi.node.body,
+                             state, origin, out)
+        return iter(out)
+
+    # -- statement walking (JLT002's shape: branch merge, loops x2) ----
+    def _walk_block(self, ctx, project, fi, sums, stmts, state, origin,
+                    out) -> None:
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            if isinstance(s, ast.If):
+                a, b = state.clone(), state.clone()
+                self._walk_block(ctx, project, fi, sums, s.body, a,
+                                 origin, out)
+                self._walk_block(ctx, project, fi, sums, s.orelse, b,
+                                 origin, out)
+                state.merge(a, b)
+            elif isinstance(s, (ast.For, ast.AsyncFor, ast.While)):
+                self._walk_block(ctx, project, fi, sums, s.body, state,
+                                 origin, out)
+                self._walk_block(ctx, project, fi, sums, s.body, state,
+                                 origin, out)
+                self._walk_block(ctx, project, fi, sums, s.orelse,
+                                 state, origin, out)
+            elif isinstance(s, ast.With):
+                self._walk_block(ctx, project, fi, sums, s.body, state,
+                                 origin, out)
+            elif isinstance(s, ast.Try):
+                self._walk_block(ctx, project, fi, sums, s.body, state,
+                                 origin, out)
+                for h in s.handlers:
+                    self._walk_block(ctx, project, fi, sums, h.body,
+                                     state.clone(), origin, out)
+                self._walk_block(ctx, project, fi, sums, s.finalbody,
+                                 state, origin, out)
+            else:
+                for node in ast.walk(s):
+                    if isinstance(node, ast.Call):
+                        self._consume(ctx, node, state, origin, out)
+                if isinstance(s, ast.Assign):
+                    self._assign(ctx, project, fi, sums, s, state,
+                                 origin)
+
+    # -- consumption ---------------------------------------------------
+    def _consume(self, ctx, call, state: _State, origin, out) -> None:
+        if _is_deriver(ctx, call):
+            return
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            name = _key_expr_name(arg)
+            if name is None or name not in state.gen:
+                continue
+            gen = state.gen[name]
+            prev = state.used.get(name)
+            if prev is not None and prev[0] == gen:
+                out.append(self.finding(
+                    ctx, call,
+                    "key %r (%s) already consumed at line %d — a key "
+                    "that crossed a function boundary is still ONE "
+                    "stream; split/fold_in before drawing again"
+                    % (name, origin.get(name, "cross-function key"),
+                       prev[1])))
+            else:
+                state.used[name] = (gen, call.lineno)
+
+    # -- binding -------------------------------------------------------
+    def _assign(self, ctx, project, fi, sums, stmt, state: _State,
+                origin) -> None:
+        value = stmt.value
+        if not isinstance(value, ast.Call):
+            # overwriting a tracked name with a non-call drops tracking
+            for tgt in stmt.targets:
+                name = _key_expr_name(tgt)
+                if name in state.gen:
+                    del state.gen[name]
+                    state.used.pop(name, None)
+            return
+        if _is_deriver(ctx, value):
+            return  # JLT002's territory: direct deriver assignment
+        callee = project.resolve_call(ctx, value, cls=fi.cls)
+        if callee is None:
+            return
+        csum = sums[callee.key]
+        if not csum.returns_fresh and not csum.passthrough:
+            return
+        for tgt in stmt.targets:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                positions = list(enumerate(tgt.elts))
+            else:
+                positions = [(-1, tgt)]
+            for pos, el in positions:
+                name = _key_expr_name(el)
+                if name is None:
+                    continue
+                if _KEY_PARAM.search(name.rsplit(".", 1)[-1]):
+                    continue  # JLT002 already tracks key-named targets
+                if pos in csum.returns_fresh:
+                    state.gen[name] = state.gen.get(name, -1) + 1
+                    state.used.pop(name, None)
+                    origin[name] = ("fresh key returned by %s()"
+                                    % callee.qualname)
+                elif pos in csum.passthrough:
+                    pidx = csum.passthrough[pos]
+                    state.gen[name] = state.gen.get(name, -1) + 1
+                    state.used.pop(name, None)
+                    origin[name] = ("key passed through %s()"
+                                    % callee.qualname)
+                    if pidx in csum.consumes:
+                        # the callee already drew from it: the target
+                        # is born consumed
+                        state.used[name] = (state.gen[name],
+                                            value.lineno)

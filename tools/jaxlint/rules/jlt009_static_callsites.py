@@ -1,0 +1,134 @@
+"""JLT009 — cross-module static-argument call sites.
+
+JLT004 is binding-local by design: it flags a mutable literal reaching
+a static position only when the ``instrument_jit(...,
+static_argnums=...)`` binding and the call live in the SAME file. But
+the package's jitted entry points are module-level bindings called
+from everywhere (``ops.histogram._pallas_histogram`` is invoked from
+the tree learners), so the obvious cross-module mistake —
+
+    # ops/histogram.py
+    _hist = instrument_jit("h", _body, static_argnums=(2,))
+    # treelearner/somewhere.py
+    from ..ops.histogram import _hist
+    _hist(bins, gh, [16, 16])       # unhashable at a static position
+
+— sailed through. This rule closes it with the project index: every
+module-level name bound from a jit-maker call with a literal static
+spec is registered project-wide; every call THROUGH such a name (in
+any module) checks its static positions.
+
+Flagged at a static position:
+
+- a mutable literal or comprehension (unhashable — ``TypeError`` at
+  call time), exactly JLT004's class;
+- a literal-fresh constructor call (``list(...)``/``dict(...)``/
+  ``set(...)``) — same unhashable crash, built one call later;
+- a tuple literal containing either of the above (hashable never, or
+  a retrace bomb if someone "fixes" the element type per call site).
+
+Same-file calls stay JLT004's (one finding per site, one owner per
+gap). Resolution is the project index's: suffix-matched module names,
+no instance-attribute indirection.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set, Tuple
+
+from ..engine import FileContext, Finding
+from . import Rule
+from .jlt004_static_args import _MUTABLE, _static_spec
+
+_FRESH_CTORS = ("list", "dict", "set")
+
+
+def _fresh_unhashable(node: ast.AST) -> Optional[str]:
+    """Why this expression can never be a sound static argument, or
+    None when it is (or might be) fine."""
+    if isinstance(node, _MUTABLE):
+        return "mutable %s literal" % type(node).__name__.lower()
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in _FRESH_CTORS:
+        return "fresh %s(...) built at the call" % node.func.id
+    if isinstance(node, ast.Tuple):
+        for el in node.elts:
+            why = _fresh_unhashable(el)
+            if why:
+                return "tuple containing a " + why
+    return None
+
+
+def _bindings(project) -> Dict[str, Tuple[Set[int], Set[str]]]:
+    """Project-wide registry: "module:name" of every module-level jit
+    binding with a literal static spec -> (static nums, static names)."""
+    cached = project.cache.get("jlt009")
+    if cached is not None:
+        return cached
+    out: Dict[str, Tuple[Set[int], Set[str]]] = {}
+    for key, assign in project.module_assigns.items():
+        if not isinstance(assign.value, ast.Call):
+            continue
+        mod = key.split(":", 1)[0]
+        ctx = next((c for c in project.contexts if c.module == mod),
+                   None)
+        if ctx is None:
+            continue
+        spec = _static_spec(ctx, assign.value)
+        if spec:
+            out[key] = (spec[0], spec[1])
+    project.cache["jlt009"] = out
+    return out
+
+
+class StaticCallSiteRule(Rule):
+    id = "JLT009"
+    name = "static-callsite"
+    summary = ("unhashable/literal-fresh value reaching a static "
+               "position of a jit binding defined in another module")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        project = ctx.project
+        if project is None:
+            return iter(())
+        bindings = _bindings(project)
+        if not bindings:
+            return iter(())
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = project.resolve_assign(
+                ctx, ctx.canonical(node.func))
+            if resolved is None:
+                continue
+            mod, name, _assign = resolved
+            spec = bindings.get(mod + ":" + name)
+            if spec is None or mod == ctx.module:
+                continue  # same-binding sites are JLT004's findings
+            nums, names = spec
+            for i, arg in enumerate(node.args):
+                if i not in nums:
+                    continue
+                why = _fresh_unhashable(arg)
+                if why:
+                    out.append(self.finding(
+                        ctx, arg,
+                        "%s at static position %d of %s.%s (bound "
+                        "with static_argnums in %s): unhashable at "
+                        "call time, or a fresh compile per call — "
+                        "pass a frozen tuple of few, stable values"
+                        % (why, i, mod, name, mod)))
+            for kw in node.keywords:
+                if kw.arg not in names:
+                    continue
+                why = _fresh_unhashable(kw.value)
+                if why:
+                    out.append(self.finding(
+                        ctx, kw.value,
+                        "%s for static arg %r of %s.%s (bound with "
+                        "static_argnames in %s): unhashable at call "
+                        "time, or a fresh compile per call — pass a "
+                        "frozen tuple of few, stable values"
+                        % (why, kw.arg, mod, name, mod)))
+        return iter(out)

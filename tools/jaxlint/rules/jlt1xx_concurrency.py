@@ -1,0 +1,609 @@
+"""JLT101/JLT102/JLT103 — the concurrency-discipline family.
+
+The review-hardening record of PRs 10–15 shows the codebase's dominant
+recurring bug class is threading discipline, not jax semantics: shed
+accounting serializing the dispatch worker behind event-log file I/O
+under the server lock (PR 10), iterate-while-mutating on the shared
+bucket-policy dict across replica predictors (PR 11), per-model gauges
+clobbered across servers. These rules encode those reviews as a gate
+over the threaded modules (``engine.THREADED_MODULES``: ``serve/``,
+``loop/``, ``obs/gateway.py``, ``obs/export.py``, ``io/shards.py``).
+
+- **JLT101 unlocked-shared-mutation** — a method reachable from a
+  thread target (``threading.Thread(target=self._run)``, an executor
+  ``submit(self._stage)``) writes an instance attribute that
+  non-worker methods also touch, without holding any of the class's
+  designated locks (attributes bound from ``threading.Lock/RLock/
+  Condition`` in the class). The PR 11 bucket-policy bug, as a rule.
+- **JLT102 blocking-under-lock** — blocking work inside a ``with
+  self._lock:`` block: ``events.emit``/``flush`` (file I/O on flush),
+  ``log.*``, ``time.sleep``, ``open``/``urlopen``, thread ``join``,
+  future ``result``, or a one-call-deep helper that does one of those.
+  The PR 10 shed-accounting bug, as a rule. ``Condition.wait`` is
+  exempt — waiting releases the lock by contract.
+- **JLT103 lock-order-inversion** — two lock acquisitions observed in
+  both orders anywhere in the project (directly nested ``with``
+  blocks, or a call made while holding a lock into a function whose
+  transitive closure acquires another). Lock identity is lexical:
+  ``module.Class.attr`` for ``self`` locks, ``module.name`` for
+  module-level locks — two code paths that nest the same PAIR of
+  named locks in opposite orders deadlock the first time their
+  threads interleave.
+
+Known limits (docs/STATIC_ANALYSIS.md): aliasing a shared attribute
+into a local (``st = self._stats[t]``) hides the write; instance-
+attribute indirection (``self.registry.publish()``) does not resolve,
+so cross-object cycles through composed objects are the runtime
+sanitizer's job (utils/locktrace.py), not this rule's.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from ..engine import FileContext, Finding
+from . import Rule
+
+_LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock",
+               "Condition": "Condition"}
+_SYNC_CTORS = ("Event", "Thread", "Timer", "Semaphore",
+               "BoundedSemaphore", "Barrier", "ThreadPoolExecutor",
+               "local", "finalize")
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "popitem",
+             "clear", "update", "setdefault", "add", "discard"}
+_COND_METHODS = {"wait", "wait_for", "notify", "notify_all", "acquire",
+                 "release", "set", "clear", "is_set", "locked"}
+_LOG_FNS = {"debug", "info", "warning", "warning_always", "error",
+            "fatal", "exception"}
+_THREADISH = re.compile(r"thread|pool|proc|pusher|exporter|worker",
+                        re.IGNORECASE)
+_FUTUREISH = re.compile(r"fut|future", re.IGNORECASE)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _threading_ctor(ctx, value: ast.AST) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition'/'sync' for a threading-object
+    constructor call, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    canon = ctx.canonical(value.func) or ""
+    parts = canon.split(".")
+    if len(parts) >= 2 and parts[0] in ("threading", "concurrent",
+                                        "weakref"):
+        tail = parts[-1]
+        if tail in _LOCK_CTORS:
+            return _LOCK_CTORS[tail]
+        if tail in _SYNC_CTORS:
+            return "sync"
+    return None
+
+
+class _ClassCx:
+    """One class's concurrency shape: locks, worker roots, and every
+    method's attribute traffic annotated with the locks held."""
+
+    def __init__(self, ctx: FileContext, node: ast.ClassDef) -> None:
+        self.ctx = ctx
+        self.node = node
+        self.name = node.name
+        self.locks: Dict[str, str] = {}     # attr -> ctor kind
+        self.sync_attrs: Set[str] = set()   # events/threads/pools
+        self.methods: Dict[str, ast.FunctionDef] = {
+            m.name: m for m in node.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.worker_roots: Set[str] = set()
+        self.calls: Dict[str, Set[str]] = {}
+        #: every self-method call: (caller, callee, node, locks held)
+        self.method_calls: List[Tuple[str, str, ast.Call,
+                                      frozenset]] = []
+        #: method -> [(attr, node, frozenset(locks held))]
+        self.writes: Dict[str, List[Tuple[str, ast.AST,
+                                          frozenset]]] = {}
+        self.reads: Dict[str, Set[str]] = {}
+        self.init_attrs: Set[str] = set()
+        for m in self.methods.values():
+            self._scan_method(m)
+
+    # -- per-method scan ----------------------------------------------
+    def _scan_method(self, m) -> None:
+        self.calls[m.name] = set()
+        self.writes[m.name] = []
+        self.reads[m.name] = set()
+        self._walk(m.name, m.body, frozenset())
+
+    def _walk(self, mname: str, stmts: Sequence[ast.stmt],
+              held: frozenset) -> None:
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                # nested defs (thread bodies defined inline) run on
+                # their own schedule: scan them with NO lock context
+                if isinstance(s, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                    self._walk(mname, s.body, frozenset())
+                continue
+            if isinstance(s, ast.With):
+                got = set(held)
+                for item in s.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None:
+                        got.add(attr)
+                self._scan_exprs(mname, s, held)  # the with items
+                self._walk(mname, s.body, frozenset(got))
+                continue
+            self._scan_exprs(mname, s, held)
+            for blk in (getattr(s, "body", None),
+                        getattr(s, "orelse", None),
+                        getattr(s, "finalbody", None)):
+                if isinstance(blk, list) and blk \
+                        and isinstance(blk[0], ast.stmt):
+                    self._walk(mname, blk, held)
+            for h in getattr(s, "handlers", []) or []:
+                self._walk(mname, h.body, held)
+
+    def _scan_exprs(self, mname: str, stmt: ast.stmt,
+                    held: frozenset) -> None:
+        todo = [stmt] if not isinstance(stmt, ast.With) \
+            else [it.context_expr for it in stmt.items]
+        seen: List[ast.AST] = []
+        while todo:
+            n = todo.pop()
+            seen.append(n)
+            for ch in ast.iter_child_nodes(n):
+                if isinstance(ch, (ast.stmt, ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                todo.append(ch)
+        for node in seen:
+            if isinstance(node, ast.Attribute):
+                attr = _self_attr(node)
+                if attr and isinstance(node.ctx, ast.Load):
+                    self.reads[mname].add(attr)
+            elif isinstance(node, ast.Call):
+                self._scan_call(mname, node, held)
+        # writes: assignment/augassign targets on this statement
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for tgt in targets:
+                base = tgt
+                while isinstance(base, (ast.Subscript, ast.Starred)):
+                    base = base.value
+                if isinstance(base, (ast.Tuple, ast.List)):
+                    elts = base.elts
+                else:
+                    elts = [base]
+                for el in elts:
+                    while isinstance(el, (ast.Subscript, ast.Starred)):
+                        el = el.value
+                    attr = _self_attr(el)
+                    if attr:
+                        self.writes[mname].append((attr, tgt, held))
+                        if mname == "__init__":
+                            self.init_attrs.add(attr)
+                            kind = _threading_ctor(
+                                self.ctx, getattr(stmt, "value", None))
+                            if kind in ("Lock", "RLock", "Condition"):
+                                self.locks[attr] = kind
+                            elif kind == "sync":
+                                self.sync_attrs.add(attr)
+
+    def _scan_call(self, mname: str, call: ast.Call,
+                   held: frozenset) -> None:
+        canon = self.ctx.canonical(call.func) or ""
+        tail = canon.rsplit(".", 1)[-1]
+        # worker roots: Thread(target=self.X) / pool.submit(self.X)
+        if tail in ("Thread", "Timer"):
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    attr = _self_attr(kw.value)
+                    if attr:
+                        self.worker_roots.add(attr)
+        elif isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "submit" and call.args:
+            attr = _self_attr(call.args[0])
+            if attr:
+                self.worker_roots.add(attr)
+        # self-method call graph
+        if isinstance(call.func, ast.Attribute):
+            attr = _self_attr(call.func)
+            if attr and attr in self.methods:
+                self.calls[mname].add(attr)
+                self.method_calls.append((mname, attr, call, held))
+            # mutating container method on a self attribute
+            if call.func.attr in _MUTATORS:
+                inner = call.func.value
+                while isinstance(inner, ast.Subscript):
+                    inner = inner.value
+                tgt_attr = _self_attr(inner)
+                if tgt_attr:
+                    self.writes[mname].append(
+                        (tgt_attr, call, held))
+
+    # -- derived -------------------------------------------------------
+    def worker_closure(self) -> Set[str]:
+        out: Set[str] = set()
+        todo = [r for r in self.worker_roots if r in self.methods]
+        while todo:
+            m = todo.pop()
+            if m in out:
+                continue
+            out.add(m)
+            todo.extend(c for c in self.calls.get(m, ())
+                        if c not in out)
+        return out
+
+
+def _classes(ctx: FileContext) -> List[_ClassCx]:
+    cached = getattr(ctx, "_jlt1xx_classes", None)
+    if cached is None:
+        cached = [_ClassCx(ctx, n) for n in ctx.tree.body
+                  if isinstance(n, ast.ClassDef)]
+        ctx._jlt1xx_classes = cached
+    return cached
+
+
+def _module_locks(ctx: FileContext) -> Dict[str, str]:
+    """Module-level names bound to threading locks in this file."""
+    out: Dict[str, str] = {}
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign):
+            kind = _threading_ctor(ctx, node.value)
+            if kind in ("Lock", "RLock", "Condition"):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = kind
+    return out
+
+
+# ----------------------------------------------------------------------
+# JLT101
+# ----------------------------------------------------------------------
+
+class UnlockedSharedMutationRule(Rule):
+    id = "JLT101"
+    name = "unlocked-shared-mutation"
+    summary = ("worker-thread method mutates a shared attribute "
+               "without the class's designated lock held")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.is_threaded_module:
+            return iter(())
+        out: List[Finding] = []
+        for cls in _classes(ctx):
+            if not cls.locks:
+                continue
+            workers = cls.worker_closure()
+            if not workers:
+                continue
+            outside = set(cls.methods) - workers - {"__init__"}
+            shared: Set[str] = set()
+            for m in outside:
+                shared |= cls.reads.get(m, set())
+                shared |= {a for a, _, _ in cls.writes.get(m, ())}
+            lock_names = set(cls.locks)
+            for m in sorted(workers):
+                if m.endswith("_locked"):
+                    # the repo convention: a *_locked method asserts
+                    # its CALLER holds the lock — audited below
+                    continue
+                for attr, node, held in cls.writes.get(m, ()):
+                    if attr in lock_names or attr in cls.sync_attrs:
+                        continue
+                    if attr not in cls.init_attrs \
+                            or attr not in shared:
+                        continue
+                    if held & lock_names:
+                        continue
+                    out.append(self.finding(
+                        ctx, node,
+                        "%s.%s runs on a worker thread and mutates "
+                        "self.%s — an attribute other methods touch — "
+                        "without holding %s; unguarded read-modify-"
+                        "write across threads loses updates"
+                        % (cls.name, m, attr,
+                           " or ".join("self." + n
+                                       for n in sorted(lock_names)))))
+            # the convention's other half: nobody may CALL a *_locked
+            # method without a designated lock actually held
+            for caller, callee, node, held in cls.method_calls:
+                if not callee.endswith("_locked"):
+                    continue
+                if caller.endswith("_locked") or caller == "__init__":
+                    continue
+                if held & lock_names:
+                    continue
+                out.append(self.finding(
+                    ctx, node,
+                    "%s.%s calls self.%s() without holding %s — the "
+                    "_locked suffix is a contract that the caller "
+                    "already holds the class lock"
+                    % (cls.name, caller, callee,
+                       " or ".join("self." + n
+                                   for n in sorted(lock_names)))))
+        return iter(out)
+
+
+# ----------------------------------------------------------------------
+# JLT102
+# ----------------------------------------------------------------------
+
+def _direct_blocking(ctx, call: ast.Call) -> Optional[str]:
+    """Why one call blocks, judged locally, or None."""
+    canon = ctx.canonical(call.func) or ""
+    parts = canon.split(".")
+    tail = parts[-1]
+    if tail in _COND_METHODS:
+        return None  # Condition/Event protocol: wait releases the lock
+    if canon == "open" or tail == "urlopen":
+        return "file/network I/O (%s)" % tail
+    if canon == "time.sleep":
+        return "time.sleep"
+    if len(parts) >= 2 and parts[-2] == "events" \
+            and tail in ("emit", "flush"):
+        return ("events.%s — the event sink flushes to disk, exactly "
+                "the PR 10 shed-accounting serialization" % tail)
+    if len(parts) >= 2 and parts[-2] == "log" and tail in _LOG_FNS:
+        return "log.%s (stderr write under contention)" % tail
+    if len(parts) >= 2 and parts[-2] == "faults" and tail == "check":
+        # the chaos probe emits a FLUSHED fault_injected event when it
+        # fires; recognized by name so a single-file scan classifies
+        # the call identically to a project scan (where the transitive
+        # summary of obs.faults.check would catch it anyway)
+        return "faults.check (flushed fault-injection emit)"
+    if tail == "retry_call":
+        return "retry_call (sleeps between attempts)"
+    if isinstance(call.func, ast.Attribute):
+        recv = call.func.value
+        recv_name = ""
+        while isinstance(recv, (ast.Subscript,)):
+            recv = recv.value
+        if isinstance(recv, ast.Attribute):
+            recv_name = recv.attr
+        elif isinstance(recv, ast.Name):
+            recv_name = recv.id
+        if call.func.attr == "join" and _THREADISH.search(recv_name):
+            return "thread join"
+        if call.func.attr == "result" and _FUTUREISH.search(recv_name):
+            return "future result wait"
+        if call.func.attr == "shutdown" and _THREADISH.search(recv_name):
+            return "executor shutdown"
+    return None
+
+
+def _blocking_summaries(project) -> Dict[str, str]:
+    """fn.key -> blocking reason for functions whose body DIRECTLY
+    blocks (one-call-deep transitivity for JLT102)."""
+    cached = project.cache.get("jlt102")
+    if cached is not None:
+        return cached
+    out: Dict[str, str] = {}
+    for fi in project.functions.values():
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                why = _direct_blocking(fi.ctx, node)
+                if why:
+                    out[fi.key] = why
+                    break
+    project.cache["jlt102"] = out
+    return out
+
+
+class BlockingUnderLockRule(Rule):
+    id = "JLT102"
+    name = "blocking-under-lock"
+    summary = ("blocking I/O, event emit/flush, or logging inside a "
+               "with-lock block")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.is_threaded_module:
+            return iter(())
+        out: List[Finding] = []
+        mod_locks = _module_locks(ctx)
+        lock_attrs = {attr for cls in _classes(ctx)
+                      for attr in cls.locks}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.With):
+                continue
+            lock_name = None
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None and attr in lock_attrs:
+                    lock_name = "self." + attr
+                elif isinstance(item.context_expr, ast.Name) \
+                        and item.context_expr.id in mod_locks:
+                    lock_name = item.context_expr.id
+            if lock_name is None:
+                continue
+            self._scan_body(ctx, node.body, lock_name, out)
+        return iter(out)
+
+    def _scan_body(self, ctx, stmts, lock_name, out) -> None:
+        cls_of: Dict[int, Optional[str]] = {}
+        enclosing = self._enclosing_classes(ctx)
+        for s in stmts:
+            for node in ast.walk(s):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                why = _direct_blocking(ctx, node)
+                if why is None and ctx.project is not None:
+                    callee = ctx.project.resolve_call(
+                        ctx, node, cls=enclosing.get(id(node)))
+                    if callee is not None:
+                        deep = _blocking_summaries(
+                            ctx.project).get(callee.key)
+                        if deep:
+                            why = "a call to %s(), which does %s" \
+                                % (callee.qualname, deep)
+                if why:
+                    out.append(self.finding(
+                        ctx, node,
+                        "blocking work inside 'with %s:': %s — every "
+                        "other thread contending for the lock "
+                        "serializes behind it; move it outside the "
+                        "critical section (snapshot under the lock, "
+                        "act after release)" % (lock_name, why)))
+
+    def _enclosing_classes(self, ctx) -> Dict[int, Optional[str]]:
+        cached = getattr(ctx, "_jlt102_cls_of", None)
+        if cached is not None:
+            return cached
+        out: Dict[int, Optional[str]] = {}
+
+        def walk(node, cls):
+            for ch in ast.iter_child_nodes(node):
+                if isinstance(ch, ast.ClassDef):
+                    walk(ch, ch.name)
+                else:
+                    if isinstance(ch, ast.Call):
+                        out[id(ch)] = cls
+                    walk(ch, cls)
+        walk(ctx.tree, None)
+        ctx._jlt102_cls_of = out
+        return out
+
+
+# ----------------------------------------------------------------------
+# JLT103
+# ----------------------------------------------------------------------
+
+def _lock_edges(project):
+    """Project-wide lock-order graph: (lockA, lockB) -> witness
+    (relpath, line, detail) for A held while acquiring B."""
+    cached = project.cache.get("jlt103")
+    if cached is not None:
+        return cached
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    fns = [fi for fi in project.functions.values()
+           if fi.ctx.is_threaded_module]
+    mod_locks = {id(fi.ctx): _module_locks(fi.ctx) for fi in fns}
+    cls_locks: Dict[Tuple[int, str], Set[str]] = {}
+    for fi in fns:
+        if fi.cls is not None \
+                and (id(fi.ctx), fi.cls) not in cls_locks:
+            for cls in _classes(fi.ctx):
+                cls_locks[(id(fi.ctx), cls.name)] = set(cls.locks)
+
+    def lock_id(fi, expr) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None and fi.cls is not None \
+                and attr in cls_locks.get((id(fi.ctx), fi.cls), ()):
+            return "%s.%s.%s" % (fi.ctx.module, fi.cls, attr)
+        if isinstance(expr, ast.Name) \
+                and expr.id in mod_locks[id(fi.ctx)]:
+            return "%s.%s" % (fi.ctx.module, expr.id)
+        return None
+
+    # pass 1: per-function direct acquisitions + resolved calls,
+    # with the lock stack at each point
+    direct: Dict[str, Set[str]] = {}
+    call_sites: Dict[str, List[Tuple[Tuple[str, ...], object]]] = {}
+
+    def walk(fi, stmts, held: Tuple[str, ...]):
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            now = held
+            if isinstance(s, ast.With):
+                for item in s.items:
+                    lid = lock_id(fi, item.context_expr)
+                    if lid is None:
+                        continue
+                    direct[fi.key].add(lid)
+                    for h in now:
+                        if h != lid:
+                            edges.setdefault((h, lid), (
+                                fi.ctx.relpath, item.context_expr.lineno,
+                                "%s acquires %s while holding %s"
+                                % (fi.qualname, lid, h)))
+                    now = now + (lid,)
+                walk(fi, s.body, now)
+                continue
+            for node in ast.walk(s):
+                if isinstance(node, ast.Call):
+                    callee = project.resolve_call(fi.ctx, node,
+                                                  cls=fi.cls)
+                    if callee is not None and held:
+                        call_sites[fi.key].append(
+                            (held, (callee.key, fi.ctx.relpath,
+                                    node.lineno, fi.qualname)))
+            for blk in (getattr(s, "body", None),
+                        getattr(s, "orelse", None),
+                        getattr(s, "finalbody", None)):
+                if isinstance(blk, list) and blk \
+                        and isinstance(blk[0], ast.stmt):
+                    walk(fi, blk, held)
+            for h in getattr(s, "handlers", []) or []:
+                walk(fi, h.body, held)
+
+    for fi in fns:
+        direct[fi.key] = set()
+        call_sites[fi.key] = []
+        walk(fi, fi.node.body, ())
+
+    # pass 2: transitive acquisition closure (bounded fixed point)
+    closure: Dict[str, Set[str]] = {k: set(v) for k, v in direct.items()}
+    for _ in range(6):
+        changed = False
+        for key, sites in call_sites.items():
+            for _held, (ckey, _rp, _ln, _qn) in sites:
+                got = closure.get(ckey)
+                if got and not got <= closure[key]:
+                    closure[key] |= got
+                    changed = True
+        if not changed:
+            break
+
+    # pass 3: call-mediated edges — holding H, calling into a closure
+    # that acquires L
+    for key, sites in call_sites.items():
+        for held, (ckey, rp, ln, qn) in sites:
+            for lid in closure.get(ckey, ()):
+                for h in held:
+                    if h != lid:
+                        edges.setdefault((h, lid), (
+                            rp, ln,
+                            "%s calls %s while holding %s (callee "
+                            "acquires %s)" % (qn, ckey.split(":")[-1],
+                                              h, lid)))
+
+    project.cache["jlt103"] = edges
+    return edges
+
+
+class LockOrderRule(Rule):
+    id = "JLT103"
+    name = "lock-order"
+    summary = ("the same lock pair acquired in both orders on "
+               "different code paths (deadlock on interleave)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.is_threaded_module or ctx.project is None:
+            return iter(())
+        edges = _lock_edges(ctx.project)
+        out: List[Finding] = []
+        for (a, b), (rp, line, detail) in edges.items():
+            if rp != ctx.relpath:
+                continue
+            rev = edges.get((b, a))
+            if rev is None:
+                continue
+            out.append(Finding(
+                self.id, ctx.path, line, 0,
+                "lock order inversion: %s, but %s:%d takes %s before "
+                "%s (%s) — two threads interleaving these paths "
+                "deadlock; pick one order and hold to it"
+                % (detail, rev[0], rev[1], b, a, rev[2])))
+        return iter(out)
